@@ -1,0 +1,21 @@
+"""kafka_tpu — a TPU-native raster data-assimilation framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of
+QCDIS/KaFKA-InferenceEngine (per-pixel linearised Kalman/information
+filtering of satellite raster time series): batched dense per-pixel solves on
+the MXU instead of giant sparse CPU LU factorizations, `lax.while_loop`
+relinearisation, mesh-sharded pixels, and a host-side streaming raster
+pipeline.  See SURVEY.md for the structural map to the reference.
+"""
+
+__version__ = "0.1.0"
+
+from . import core
+from .core import (  # noqa: F401 — flat re-export API like the reference's kafka/__init__.py:1-4
+    BandBatch,
+    GaussianState,
+    Linearization,
+    PixelPrior,
+    iterate_time_grid,
+    tip_prior,
+)

@@ -1,0 +1,45 @@
+"""Pure-JAX batched kernels: the math core of the framework."""
+
+from .linalg import (
+    batched_diag,
+    batched_diagonal,
+    solve_batched,
+    solve_spd_batched,
+    spd_inverse_batched,
+)
+from .propagators import (
+    PixelPrior,
+    advance,
+    blend_gaussians,
+    blend_prior,
+    broadcast_prior,
+    make_no_propagation,
+    make_prior_reset_propagator,
+    no_propagation,
+    propagate_information_filter,
+    propagate_information_filter_approx,
+    propagate_information_filter_lai,
+    propagate_standard_kalman,
+    tip_prior,
+)
+from .solvers import (
+    CONVERGENCE_TOL,
+    MAX_ITERATIONS,
+    MIN_ITERATIONS,
+    assimilate_date_jit,
+    build_normal_equations,
+    iterated_solve,
+    kalman_update,
+    linear_solve,
+)
+from .hessian import hessian_correction
+from .time_grid import iterate_time_grid
+from .types import (
+    BandBatch,
+    GaussianState,
+    Linearization,
+    SolveDiagnostics,
+    block_diag_to_batched,
+    flat_to_pixel_major,
+    pixel_major_to_flat,
+)

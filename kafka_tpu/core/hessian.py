@@ -1,0 +1,51 @@
+"""Second-order (Hessian) correction of the posterior information matrix.
+
+The Gauss-Newton Hessian ``J^T R^-1 J + P_f^-1`` drops the term
+``sum_k r_inv_k * innov_k * d2H_k/dx2``.  The reference adds it back per
+pixel using the GP emulator's ``.hessian`` method scattered through the
+band->state mapper (``/root/reference/kafka/inference/kf_tools.py:26-72``)
+and subtracts it from the returned Hessian (``linear_kf.py:412-416``).
+
+Here the observation operator is a differentiable JAX function, so the
+second derivative comes from ``jax.hessian`` of the per-pixel forward model —
+no hand-coded Hessians, and the whole correction is one vmap over pixels.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def hessian_correction(
+    forward_per_pixel: Callable[[jnp.ndarray], jnp.ndarray],
+    x_analysis: jnp.ndarray,
+    r_inv: jnp.ndarray,
+    innovations: jnp.ndarray,
+    obs_mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """Batched second-order correction term.
+
+    Parameters
+    ----------
+    forward_per_pixel : maps one pixel's state ``(p,)`` to its per-band
+        forward-modelled observations ``(n_bands,)``.
+    x_analysis : (n_pix, p) converged analysis state.
+    r_inv : (n_bands, n_pix) inverse observation variances.
+    innovations : (n_bands, n_pix) ``y - H0`` innovations
+        (``solvers.py:139-142`` convention).
+    obs_mask : (n_bands, n_pix) validity mask — masked pixels contribute a
+        zero block, as in ``kf_tools.py:49-52``.
+
+    Returns
+    -------
+    (n_pix, p, p) correction; subtract it from the analysis information
+    matrix (``linear_kf.py:416``: ``P_analysis_inverse - P_correction``).
+    """
+
+    per_pixel_hessian = jax.vmap(jax.hessian(forward_per_pixel))
+    ddh = per_pixel_hessian(x_analysis)  # (n_pix, n_bands, p, p)
+    weight = (r_inv * innovations * obs_mask).T  # (n_pix, n_bands)
+    return jnp.einsum("nb,nbpq->npq", weight, ddh)

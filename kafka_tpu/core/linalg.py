@@ -1,0 +1,68 @@
+"""Batched small-matrix linear algebra for the per-pixel solves.
+
+The reference's dominant kernel is a SuperLU factorization of a sparse
+block-diagonal system of ``n_pix`` independent ``p x p`` SPD blocks
+(``/root/reference/kafka/inference/solvers.py:125-134``; block-diagonality is
+guaranteed because every Jacobian row only touches its own pixel's parameters,
+``inference/utils.py:193-215``).  On TPU this is a batched dense Cholesky
+factorization + triangular solve over the pixel batch axis — no sparse
+machinery, no host BLAS, fully fused by XLA and shardable over a mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def solve_spd_batched(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Solve ``a[i] @ x[i] = b[i]`` for a batch of SPD matrices.
+
+    Parameters
+    ----------
+    a : (..., p, p) SPD matrices (the per-pixel information matrices).
+    b : (..., p) right-hand sides.
+
+    Uses batched Cholesky (``lax.linalg.cholesky``) + two triangular solves.
+    Replaces the reference's ``sp.linalg.splu(A).solve(b)``
+    (``solvers.py:133-134``) exactly on SPD input, at ~p^3/3 flops per pixel.
+    """
+    chol = jax.lax.linalg.cholesky(a)
+    y = jax.lax.linalg.triangular_solve(
+        chol, b[..., None], left_side=True, lower=True
+    )
+    x = jax.lax.linalg.triangular_solve(
+        chol, y, left_side=True, lower=True, transpose_a=True
+    )
+    return x[..., 0]
+
+
+def solve_batched(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """General batched solve (LU) for non-symmetric per-pixel systems.
+
+    Needed by the exact information-filter propagator, which solves
+    ``(I + P_inv Q) X = P_inv`` where the left side is not symmetric
+    (``kf_tools.py:240-242``).
+    """
+    return jnp.linalg.solve(a, b)
+
+
+def spd_inverse_batched(a: jnp.ndarray) -> jnp.ndarray:
+    """Batched SPD inverse via Cholesky (used to turn p_inv into p and back
+    for the covariance-form propagator, ``kf_tools.py:203-205``)."""
+    chol = jax.lax.linalg.cholesky(a)
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    y = jax.lax.linalg.triangular_solve(chol, eye, left_side=True, lower=True)
+    return jax.lax.linalg.triangular_solve(
+        chol, y, left_side=True, lower=True, transpose_a=True
+    )
+
+
+def batched_diag(d: jnp.ndarray) -> jnp.ndarray:
+    """``(..., p)`` diagonals -> ``(..., p, p)`` diagonal matrices."""
+    return d[..., None] * jnp.eye(d.shape[-1], dtype=d.dtype)
+
+
+def batched_diagonal(a: jnp.ndarray) -> jnp.ndarray:
+    """``(..., p, p)`` -> ``(..., p)`` main diagonals."""
+    return jnp.diagonal(a, axis1=-2, axis2=-1)

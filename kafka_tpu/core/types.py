@@ -1,0 +1,127 @@
+"""Core array containers for the TPU-native assimilation engine.
+
+Design note
+-----------
+The reference engine (KaFKA) represents the state of an ``ny x nx`` raster as a
+single flat, pixel-major-interleaved vector ``x = [pix0 params | pix1 params |
+...]`` and carries a giant sparse block-diagonal inverse covariance (see
+``/root/reference/kafka/inference/solvers.py:60-69`` and the slicing patterns
+``x[ii::n_params]`` in ``observations.py:375``).  On TPU the idiomatic layout
+is *batched dense*: the state is ``(n_pix, p)`` and the information matrix is
+``(n_pix, p, p)`` — XLA then maps the per-pixel linear algebra onto the
+MXU/VPU with the pixel axis as the (shardable) batch axis.  ``StateVector``
+provides lossless converters between the two layouts so outputs match the
+reference bit-for-bit in ordering.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax.numpy as jnp
+
+
+class BandBatch(NamedTuple):
+    """All observations of one date, batched over bands.
+
+    Mirrors the per-band namedtuples of the reference readers
+    (``S2MSIdata``/``S1data``/``BHR_data``: observations, uncertainty, mask,
+    metadata, emulator — ``Sentinel2_Observations.py:80-81``) but stacked to
+    fixed shapes for jit:
+
+    - ``y``:      ``(n_bands, n_pix)`` observed values (gathered to the state
+                  mask's pixel list, padded to a fixed pixel count).
+    - ``r_inv``:  ``(n_bands, n_pix)`` *inverse variance* of each observation.
+      The reference stores uncertainty as inverse variance everywhere
+      (``Sentinel2_Observations.py:174-179``) and the solver uses it directly
+      as R^-1.  Masked / missing observations carry ``r_inv == 0`` which
+      removes them from the update exactly (unlike the reference's ``y=0``
+      trick, ``solvers.py:53`` — same posterior, no inf rows).
+    - ``mask``:   ``(n_bands, n_pix)`` bool, True where the observation is
+                  valid.  Redundant with ``r_inv > 0`` but kept for
+                  diagnostics and innovation reporting.
+    """
+
+    y: jnp.ndarray
+    r_inv: jnp.ndarray
+    mask: jnp.ndarray
+
+
+class GaussianState(NamedTuple):
+    """Batched per-pixel Gaussian belief in information form.
+
+    - ``x``:     ``(n_pix, p)`` mean.
+    - ``p_inv``: ``(n_pix, p, p)`` inverse covariance (information matrix).
+      The reference never forms the posterior covariance; it carries the
+      Hessian ``A`` as ``P_analysis_inverse`` (``solvers.py:78``) and
+      consumers only read its diagonal (``observations.py:393``).  We keep
+      the same contract.
+    - ``p``:     optional ``(n_pix, p, p)`` covariance for the
+      covariance-form Kalman propagator (``kf_tools.py:203-205``); ``None``
+      in information-filter mode.
+    """
+
+    x: jnp.ndarray
+    p_inv: Optional[jnp.ndarray]
+    p: Optional[jnp.ndarray] = None
+
+
+class Linearization(NamedTuple):
+    """Observation operator linearized around a state point.
+
+    - ``h0``:  ``(n_bands, n_pix)`` forward-modelled observation at the
+               linearization point.
+    - ``jac``: ``(n_bands, n_pix, p)`` Jacobian d h0 / d x.
+
+    Equivalent of the reference's ``(H0, H_matrix)`` pair where ``H_matrix``
+    is an ``(n_pix, p*n_pix)`` sparse matrix whose row i only touches pixel
+    i's parameters (``inference/utils.py:193-215``) — i.e. exactly a batched
+    ``(n_pix, p)`` Jacobian per band.
+    """
+
+    h0: jnp.ndarray
+    jac: jnp.ndarray
+
+
+class SolveDiagnostics(NamedTuple):
+    """Extras returned by the iterated solve.
+
+    ``innovations`` follows the reference multiband convention
+    ``y_orig - H0`` (``solvers.py:139-142``); ``fwd_modelled`` is
+    ``J (x_a - x_f) + H0`` (``solvers.py:70-71``); ``n_iterations`` and
+    ``convergence_norm`` mirror the loop diagnostics of
+    ``linear_kf.py:293-296``.
+    """
+
+    innovations: jnp.ndarray
+    fwd_modelled: jnp.ndarray
+    n_iterations: jnp.ndarray
+    convergence_norm: jnp.ndarray
+
+
+def flat_to_pixel_major(x_flat: jnp.ndarray, n_params: int) -> jnp.ndarray:
+    """``(n_pix*p,)`` interleaved reference layout -> ``(n_pix, p)``."""
+    return x_flat.reshape(-1, n_params)
+
+
+def pixel_major_to_flat(x: jnp.ndarray) -> jnp.ndarray:
+    """``(n_pix, p)`` -> the reference's interleaved flat layout."""
+    return x.reshape(-1)
+
+
+def block_diag_to_batched(p_mat: Any, n_params: int) -> jnp.ndarray:
+    """Dense/scipy block-diagonal ``(n_pix*p, n_pix*p)`` -> ``(n_pix, p, p)``.
+
+    Host-side helper for interop tests against the reference layout.
+    """
+    import numpy as np
+
+    if hasattr(p_mat, "toarray"):
+        p_mat = p_mat.toarray()
+    p_mat = np.asarray(p_mat)
+    n = p_mat.shape[0] // n_params
+    out = np.empty((n, n_params, n_params), dtype=p_mat.dtype)
+    for i in range(n):
+        sl = slice(i * n_params, (i + 1) * n_params)
+        out[i] = p_mat[sl, sl]
+    return jnp.asarray(out)

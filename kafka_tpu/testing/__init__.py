@@ -1,0 +1,4 @@
+"""Test/bench support: NumPy oracles of the reference math and synthetic
+data generators."""
+
+from . import oracle

@@ -1,0 +1,138 @@
+"""NumPy/SciPy oracle of the reference solver path.
+
+A faithful re-implementation (NOT a copy) of the reference's sparse
+block-diagonal math, used for two things:
+
+1. numerical parity tests of the batched JAX kernels (the reference's own
+   unit tests were broken at import — SURVEY.md §4 — so these oracles are the
+   executable spec), and
+2. the measured CPU baseline for ``bench.py`` — the reference publishes no
+   numbers (SURVEY.md §6), so the baseline protocol is to *measure* this
+   SuperLU path and compare pixels/sec.
+
+Formulas mirrored:
+ - normal equations + splu solve: ``/root/reference/kafka/inference/solvers.py:100-145``
+ - relinearisation shift: ``solvers.py:95``
+ - convergence loop: ``linear_kf.py:245-307`` (tol 1e-3, min 2, bail >25)
+ - information propagation: ``kf_tools.py:208-245`` and ``:247-289``
+ - prior blending: ``kf_tools.py:75-96``
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple
+
+import numpy as np
+import scipy.sparse as sp
+import scipy.sparse.linalg as spl
+
+
+def build_sparse_h(jac: np.ndarray) -> sp.csr_matrix:
+    """Pack a batched Jacobian (n_pix, p) for one band into the reference's
+    sparse layout: row i touches columns [i*p, (i+1)*p)
+    (``inference/utils.py:193-215``)."""
+    n_pix, p = jac.shape
+    rows = np.repeat(np.arange(n_pix), p)
+    cols = np.arange(n_pix * p)
+    return sp.csr_matrix(
+        (jac.ravel(), (rows, cols)), shape=(n_pix, n_pix * p)
+    )
+
+
+def block_diag_dense(blocks: np.ndarray) -> sp.csr_matrix:
+    """(n_pix, p, p) -> sparse block-diagonal, reference state layout."""
+    return sp.block_diag(list(blocks), format="csc")
+
+
+def sparse_multiband_solve(
+    h0_b: Sequence[np.ndarray],
+    jac_b: Sequence[np.ndarray],
+    y_b: Sequence[np.ndarray],
+    r_inv_b: Sequence[np.ndarray],
+    mask_b: Sequence[np.ndarray],
+    x_lin: np.ndarray,
+    x_forecast: np.ndarray,
+    p_inv_blocks: np.ndarray,
+) -> Tuple[np.ndarray, sp.spmatrix]:
+    """One linearised multiband update via sparse splu, mirroring
+    ``variational_kalman_multiband`` (``solvers.py:100-145``).
+
+    All band inputs are per-pixel dense arrays; the masked-obs convention is
+    the reference's: ``y`` is zeroed where masked and the uncertainty row is
+    zeroed before inversion, so masked rows have R^-1 = 0 contribution.
+    Returns the flat interleaved analysis state and the sparse Hessian A.
+    """
+    x_forecast = np.asarray(x_forecast).ravel()
+    h_rows, y_rows, r_rows = [], [], []
+    x_lin_flat = np.asarray(x_lin).ravel()
+    for h0, jac, y, r_inv, mask in zip(h0_b, jac_b, y_b, r_inv_b, mask_b):
+        h_sp = build_sparse_h(jac)
+        y_shift = np.where(mask, y, 0.0) + h_sp.dot(x_lin_flat) - h0
+        h_rows.append(h_sp)
+        y_rows.append(y_shift)
+        r_rows.append(np.where(mask, r_inv, 0.0))
+    big_h = sp.vstack(h_rows).tocsr()
+    big_r = sp.diags(np.hstack(r_rows))
+    big_y = np.hstack(y_rows)
+    p_inv = block_diag_dense(p_inv_blocks)
+    a = (big_h.T.dot(big_r).dot(big_h) + p_inv).astype(np.float32)
+    b = (
+        big_h.T.dot(big_r).dot(big_y) + p_inv.dot(x_forecast)
+    ).astype(np.float32)
+    lu = spl.splu(a.tocsc())
+    x = lu.solve(b)
+    return x, a
+
+
+def iterated_sparse_solve(
+    linearize: Callable[[np.ndarray], Tuple[List[np.ndarray], List[np.ndarray]]],
+    y_b: Sequence[np.ndarray],
+    r_inv_b: Sequence[np.ndarray],
+    mask_b: Sequence[np.ndarray],
+    x_forecast: np.ndarray,
+    p_inv_blocks: np.ndarray,
+    tol: float = 1e-3,
+    min_iterations: int = 2,
+    max_iterations: int = 25,
+) -> Tuple[np.ndarray, sp.spmatrix, int]:
+    """The reference's Gauss-Newton loop (``linear_kf.py:245-307``) around
+    the sparse solve.  ``linearize(x)`` returns per-band ``(h0_b, jac_b)``
+    evaluated on the (n_pix, p) state."""
+    n_params = p_inv_blocks.shape[-1]
+    x_prev = x_forecast.ravel().copy()
+    n_iter = 1
+    while True:
+        h0_b, jac_b = linearize(x_prev.reshape(-1, n_params))
+        x_new, a = sparse_multiband_solve(
+            h0_b, jac_b, y_b, r_inv_b, mask_b,
+            x_prev.reshape(-1, n_params), x_forecast, p_inv_blocks,
+        )
+        norm = np.linalg.norm(x_new - x_prev) / float(len(x_new))
+        if (norm < tol and n_iter >= min_iterations) or n_iter > max_iterations:
+            return x_new, a, n_iter
+        x_prev = x_new.copy()
+        n_iter += 1
+
+
+def propagate_information_filter_np(p_inv_blocks: np.ndarray,
+                                    q_diag: np.ndarray) -> np.ndarray:
+    """Exact information propagation oracle (``kf_tools.py:208-245``):
+    solve ``(I + P_inv Q) X = P_inv`` blockwise with dense LAPACK."""
+    out = np.empty_like(p_inv_blocks)
+    p = p_inv_blocks.shape[-1]
+    q = np.diag(np.broadcast_to(q_diag, (p,)))
+    for i, blk in enumerate(p_inv_blocks):
+        out[i] = np.linalg.solve(np.eye(p) + blk @ q, blk)
+    return out
+
+
+def blend_prior_np(prior_mean, prior_inv_blocks, x_forecast, p_inv_blocks):
+    """Prior blending oracle preserving the reference's operand pairing
+    (``kf_tools.py:89-94``)."""
+    a = block_diag_dense(p_inv_blocks + prior_inv_blocks)
+    b = (
+        block_diag_dense(p_inv_blocks).dot(prior_mean.ravel())
+        + block_diag_dense(prior_inv_blocks).dot(x_forecast.ravel())
+    ).astype(np.float32)
+    lu = spl.splu(a.tocsc())
+    return lu.solve(b), a

@@ -1,0 +1,38 @@
+"""Test configuration: force an 8-device CPU mesh for sharding tests.
+
+Must run before the first ``import jax`` in any test module (pytest imports
+conftest first).  The axon TPU plugin registers itself via sitecustomize and
+pins the default backend, so tests always resolve devices explicitly through
+``cpu_devices()`` below rather than relying on ``jax.devices()``.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+def cpu_devices():
+    return jax.devices("cpu")
+
+
+@pytest.fixture(scope="session")
+def eight_cpu_devices():
+    devs = cpu_devices()
+    if len(devs) < 8:
+        pytest.skip("need 8 host-platform devices")
+    return devs[:8]
+
+
+@pytest.fixture(autouse=True)
+def _default_to_cpu():
+    # Keep every test on the host platform even when a TPU plugin is present.
+    with jax.default_device(cpu_devices()[0]):
+        yield

@@ -1,0 +1,225 @@
+"""Parity of the batched TPU solver against the sparse SciPy oracle.
+
+The oracle mirrors the reference formulas (``solvers.py:100-145``,
+``linear_kf.py:245-307``); these tests are the numerical spec the reference's
+own (broken) tests never provided — SURVEY.md §4.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from kafka_tpu.core import (
+    BandBatch,
+    Linearization,
+    build_normal_equations,
+    iterated_solve,
+    kalman_update,
+    linear_solve,
+)
+from kafka_tpu.testing import oracle
+
+RNG = np.random.default_rng(42)
+
+
+def random_problem(n_pix=37, p=7, n_bands=2, mask_frac=0.2):
+    """A random nonlinear-free linearised problem with masked observations."""
+    jac = RNG.normal(size=(n_bands, n_pix, p)).astype(np.float32)
+    h0 = RNG.normal(size=(n_bands, n_pix)).astype(np.float32)
+    y = RNG.normal(size=(n_bands, n_pix)).astype(np.float32)
+    r_inv = RNG.uniform(0.5, 2.0, size=(n_bands, n_pix)).astype(np.float32)
+    mask = RNG.uniform(size=(n_bands, n_pix)) > mask_frac
+    x_forecast = RNG.normal(size=(n_pix, p)).astype(np.float32)
+    x_lin = x_forecast + 0.1 * RNG.normal(size=(n_pix, p)).astype(np.float32)
+    # SPD prior information blocks
+    w = RNG.normal(size=(n_pix, p, p)).astype(np.float32)
+    p_inv = np.einsum("npq,nrq->npr", w, w) + 3.0 * np.eye(p, dtype=np.float32)
+    return jac, h0, y, r_inv, mask, x_forecast, x_lin, p_inv
+
+
+def to_band_batch(y, r_inv, mask):
+    return BandBatch(
+        y=jnp.asarray(np.where(mask, y, 0.0)),
+        r_inv=jnp.asarray(np.where(mask, r_inv, 0.0)),
+        mask=jnp.asarray(mask),
+    )
+
+
+class TestKalmanUpdate:
+    def test_matches_sparse_oracle(self):
+        jac, h0, y, r_inv, mask, x_f, x_lin, p_inv = random_problem()
+        obs = to_band_batch(y, r_inv, mask)
+        lin = Linearization(h0=jnp.asarray(h0), jac=jnp.asarray(jac))
+        x_tpu, a_tpu = kalman_update(
+            lin, obs, jnp.asarray(x_lin), jnp.asarray(x_f), jnp.asarray(p_inv)
+        )
+        x_ref, a_ref = oracle.sparse_multiband_solve(
+            list(h0), list(jac), list(y), list(r_inv), list(mask),
+            x_lin, x_f, p_inv,
+        )
+        np.testing.assert_allclose(
+            np.asarray(x_tpu).ravel(), x_ref, rtol=2e-4, atol=2e-4
+        )
+        # The Hessian A must equal the oracle's sparse A blockwise.
+        n_pix, p = x_f.shape
+        a_dense = np.asarray(a_ref.todense())
+        for i in range(0, n_pix, 7):
+            sl = slice(i * p, (i + 1) * p)
+            np.testing.assert_allclose(
+                np.asarray(a_tpu)[i], a_dense[sl, sl], rtol=1e-4, atol=1e-4
+            )
+
+    def test_masked_observation_equals_dropped_row(self):
+        """r_inv = 0 must give the identical posterior to physically removing
+        the observation (the mathematically-correct version of the
+        reference's y=0 hack, solvers.py:53)."""
+        jac, h0, y, r_inv, mask, x_f, x_lin, p_inv = random_problem(
+            n_pix=5, n_bands=3, mask_frac=0.0
+        )
+        mask = np.ones_like(mask)
+        mask[1, 2] = False  # drop band 1 of pixel 2
+        obs = to_band_batch(y, r_inv, mask)
+        lin = Linearization(h0=jnp.asarray(h0), jac=jnp.asarray(jac))
+        x_a, _ = kalman_update(
+            lin, obs, jnp.asarray(x_lin), jnp.asarray(x_f), jnp.asarray(p_inv)
+        )
+        # Oracle with the row genuinely removed (r_inv -> 0 there).
+        r0 = r_inv.copy()
+        r0[1, 2] = 0.0
+        x_ref, _ = oracle.sparse_multiband_solve(
+            list(h0), list(jac), list(y), list(r0),
+            list(np.ones_like(mask)), x_lin, x_f, p_inv,
+        )
+        np.testing.assert_allclose(
+            np.asarray(x_a).ravel(), x_ref, rtol=2e-4, atol=2e-4
+        )
+
+    def test_single_band(self):
+        jac, h0, y, r_inv, mask, x_f, x_lin, p_inv = random_problem(n_bands=1)
+        obs = to_band_batch(y, r_inv, mask)
+        lin = Linearization(h0=jnp.asarray(h0), jac=jnp.asarray(jac))
+        x_tpu, _ = kalman_update(
+            lin, obs, jnp.asarray(x_lin), jnp.asarray(x_f), jnp.asarray(p_inv)
+        )
+        x_ref, _ = oracle.sparse_multiband_solve(
+            list(h0), list(jac), list(y), list(r_inv), list(mask),
+            x_lin, x_f, p_inv,
+        )
+        np.testing.assert_allclose(
+            np.asarray(x_tpu).ravel(), x_ref, rtol=2e-4, atol=2e-4
+        )
+
+
+class TestIteratedSolve:
+    def test_nonlinear_convergence_matches_oracle(self):
+        """Full Gauss-Newton loop on a genuinely nonlinear obs operator
+        (quadratic model) must converge to the oracle's solution with the
+        same loop-control semantics."""
+        n_pix, p, n_bands = 23, 4, 2
+        coeff = RNG.uniform(0.5, 1.5, size=(n_bands, p)).astype(np.float32)
+        x_f = np.full((n_pix, p), 0.8, np.float32)
+        x_true = x_f + RNG.normal(0.0, 0.05, size=(n_pix, p)).astype(np.float32)
+        y = np.stack(
+            [np.einsum("p,np->n", c, x_true**2) for c in coeff]
+        ).astype(np.float32)
+        r_inv = np.full((n_bands, n_pix), 25.0, np.float32)
+        mask = np.ones((n_bands, n_pix), bool)
+        p_inv = np.broadcast_to(
+            4.0 * np.eye(p, dtype=np.float32), (n_pix, p, p)
+        ).copy()
+
+        def forward_np(x):  # (n_pix, p) -> per-band h0, jac lists
+            h0 = [np.einsum("p,np->n", c, x**2) for c in coeff]
+            jac = [2.0 * c[None, :] * x for c in coeff]
+            return h0, jac
+
+        def linearize_jax(x):
+            h0 = jnp.stack(
+                [jnp.einsum("p,np->n", jnp.asarray(c), x**2) for c in coeff]
+            )
+            jac = jnp.stack([2.0 * jnp.asarray(c)[None, :] * x for c in coeff])
+            return Linearization(h0=h0, jac=jac)
+
+        obs = to_band_batch(y, r_inv, mask)
+        x_tpu, a_tpu, diags = iterated_solve(
+            linearize_jax, obs, jnp.asarray(x_f), jnp.asarray(p_inv)
+        )
+        x_ref, a_ref, n_iter_ref = oracle.iterated_sparse_solve(
+            forward_np, list(y), list(r_inv), list(mask), x_f, p_inv
+        )
+        np.testing.assert_allclose(
+            np.asarray(x_tpu).ravel(), x_ref, rtol=5e-4, atol=5e-4
+        )
+        assert int(diags.n_iterations) == n_iter_ref
+        assert float(diags.convergence_norm) < 1e-3
+
+    def test_loop_bails_at_cap(self):
+        """A pathological operator that never converges must stop after the
+        reference's hard cap (26 solves: n_iter > 25, linear_kf.py:299)."""
+        n_pix, p = 4, 3
+        obs = to_band_batch(
+            np.ones((1, n_pix), np.float32),
+            np.ones((1, n_pix), np.float32),
+            np.ones((1, n_pix), bool),
+        )
+
+        def linearize(x):
+            # Oscillating linearisation -> no convergence.
+            h0 = 100.0 * jnp.sin(37.0 * x.sum(-1))[None, :]
+            jac = jnp.ones((1, n_pix, p)) * jnp.cos(37.0 * x.sum(-1))[None, :, None] * 50.0
+            return Linearization(h0=h0, jac=jac)
+
+        x_f = jnp.zeros((n_pix, p), jnp.float32)
+        p_inv = jnp.broadcast_to(jnp.eye(p), (n_pix, p, p)).astype(jnp.float32)
+        _, _, diags = iterated_solve(linearize, obs, x_f, p_inv)
+        assert int(diags.n_iterations) == 26
+
+    def test_linear_operator_converges_in_min_iterations(self):
+        """With a linear operator the second iterate equals the first, so the
+        loop must exit at exactly min_iterations = 2 solves."""
+        jac, h0, y, r_inv, mask, x_f, _x_lin, p_inv = random_problem()
+
+        def linearize(x):
+            return Linearization(
+                h0=jnp.einsum("bnp,np->bn", jnp.asarray(jac), x),
+                jac=jnp.asarray(jac),
+            )
+
+        obs = to_band_batch(y, r_inv, mask)
+        _, _, diags = iterated_solve(
+            linearize, obs, jnp.asarray(x_f), jnp.asarray(p_inv)
+        )
+        assert int(diags.n_iterations) == 2
+
+
+class TestLinearSolve:
+    def test_identity_operator_scalar_update(self):
+        """Identity H, diagonal prior: posterior must equal the closed-form
+        scalar Bayes update per pixel/param."""
+        n_pix, p = 11, 3
+        x_f = RNG.normal(size=(n_pix, p)).astype(np.float32)
+        y = RNG.normal(size=(1, n_pix)).astype(np.float32)
+        r_inv = np.full((1, n_pix), 4.0, np.float32)
+        prior_info = 2.0
+        p_inv = np.broadcast_to(
+            prior_info * np.eye(p, dtype=np.float32), (n_pix, p, p)
+        ).copy()
+        # H observes parameter 0 only.
+        jac = np.zeros((1, n_pix, p), np.float32)
+        jac[0, :, 0] = 1.0
+        h0 = x_f[:, 0][None, :]
+        obs = BandBatch(
+            y=jnp.asarray(y), r_inv=jnp.asarray(r_inv),
+            mask=jnp.ones((1, n_pix), bool),
+        )
+        lin = Linearization(h0=jnp.asarray(h0), jac=jnp.asarray(jac))
+        x_a, a, diags = linear_solve(
+            lin, obs, jnp.asarray(x_f), jnp.asarray(p_inv)
+        )
+        expected0 = (4.0 * y[0] + prior_info * x_f[:, 0]) / (4.0 + prior_info)
+        np.testing.assert_allclose(
+            np.asarray(x_a)[:, 0], expected0, rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(x_a)[:, 1:], x_f[:, 1:], rtol=1e-5
+        )

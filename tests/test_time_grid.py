@@ -1,0 +1,37 @@
+"""Time-grid windowing — the fixed version of the reference's
+``tests/test_utils.py`` (broken import of ``kafka.utils``; SURVEY.md §4),
+with its exact scenario preserved."""
+
+import datetime
+
+from kafka_tpu.core import iterate_time_grid
+
+
+def test_iterate_time_grid_reference_scenario():
+    base = datetime.datetime(2007, 7, 1)
+    time_grid = [base + i * datetime.timedelta(days=1) for i in range(0, 60, 16)]
+    base = datetime.datetime(2007, 1, 1)
+    the_dates = [
+        base + i * datetime.timedelta(days=1) for i in range(1, 365 + 8, 8)
+    ]
+    expected_steps = [
+        datetime.datetime(2007, 7, 17),
+        datetime.datetime(2007, 8, 2),
+        datetime.datetime(2007, 8, 18),
+    ]
+    expected_obs = [
+        [datetime.datetime(2007, 7, 5), datetime.datetime(2007, 7, 13)],
+        [datetime.datetime(2007, 7, 21), datetime.datetime(2007, 7, 29)],
+        [datetime.datetime(2007, 8, 6), datetime.datetime(2007, 8, 14)],
+    ]
+    out = list(iterate_time_grid(time_grid, the_dates))
+    assert [o[0] for o in out] == expected_steps
+    assert [o[1] for o in out] == expected_obs
+    assert [o[2] for o in out] == [True, False, False]
+
+
+def test_first_flag_and_empty_windows():
+    grid = [0, 10, 20, 30]
+    dates = [12, 15]
+    out = list(iterate_time_grid(grid, dates))
+    assert out == [(10, [], True), (20, [12, 15], False), (30, [], False)]
