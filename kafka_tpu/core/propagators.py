@@ -19,6 +19,7 @@ from __future__ import annotations
 
 from typing import NamedTuple, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -194,10 +195,11 @@ def blend_prior(prior_mean, prior_cov_inverse, x_forecast,
     conventional pairing via ``blend_gaussians``.
     The sparse-LU solve becomes a batched p x p SPD solve.
     """
+    hi = jax.lax.Precision.HIGHEST
     combined_cov_inv = p_forecast_inverse + prior_cov_inverse
-    b = jnp.einsum("npq,nq->np", p_forecast_inverse, prior_mean) + jnp.einsum(
-        "npq,nq->np", prior_cov_inverse, x_forecast
-    )
+    b = jnp.einsum(
+        "npq,nq->np", p_forecast_inverse, prior_mean, precision=hi
+    ) + jnp.einsum("npq,nq->np", prior_cov_inverse, x_forecast, precision=hi)
     x_combined = solve_spd_batched(combined_cov_inv, b.astype(jnp.float32))
     return x_combined, combined_cov_inv
 
@@ -206,9 +208,10 @@ def blend_gaussians(mean_a, inv_cov_a, mean_b, inv_cov_b):
     """Textbook product of Gaussians: each mean weighted by its *own*
     information matrix.  (The mathematically conventional form of
     ``blend_prior``; provided for new code.)"""
+    hi = jax.lax.Precision.HIGHEST
     combined = inv_cov_a + inv_cov_b
-    b = jnp.einsum("npq,nq->np", inv_cov_a, mean_a) + jnp.einsum(
-        "npq,nq->np", inv_cov_b, mean_b
+    b = jnp.einsum("npq,nq->np", inv_cov_a, mean_a, precision=hi) + jnp.einsum(
+        "npq,nq->np", inv_cov_b, mean_b, precision=hi
     )
     return solve_spd_batched(combined, b.astype(jnp.float32)), combined
 

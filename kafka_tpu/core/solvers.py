@@ -61,6 +61,11 @@ def build_normal_equations(
     iteration (``solvers.py:100-127`` passes both).
     """
     f32 = jnp.float32
+    # Full float32 contraction precision is load-bearing: TPU einsum defaults
+    # to bfloat16 multiplies, and with R^-1 ~ 1e5 the bf16 rounding error
+    # exceeds the prior's small eigenvalues, making A numerically indefinite
+    # and the Cholesky NaN.
+    hi = jax.lax.Precision.HIGHEST
     jac = lin.jac.astype(f32)
     r_inv = obs.r_inv.astype(f32)
     # Relinearised pseudo-observation: y + J x_lin - H0  (solvers.py:56,95).
@@ -69,14 +74,18 @@ def build_normal_equations(
     # solvers.py:53).
     y_tilde = jnp.where(
         obs.mask,
-        obs.y.astype(f32) + jnp.einsum("bnp,np->bn", jac, x_lin) - lin.h0,
+        obs.y.astype(f32)
+        + jnp.einsum("bnp,np->bn", jac, x_lin, precision=hi)
+        - lin.h0,
         0.0,
     )
     # A = sum_b J^T R^-1 J + P_f^-1 : contraction over the band axis.
-    a = jnp.einsum("bnp,bn,bnq->npq", jac, r_inv, jac) + p_inv_forecast
-    b = jnp.einsum("bnp,bn,bn->np", jac, r_inv, y_tilde) + jnp.einsum(
-        "npq,nq->np", p_inv_forecast, x_forecast
-    )
+    a = jnp.einsum(
+        "bnp,bn,bnq->npq", jac, r_inv, jac, precision=hi
+    ) + p_inv_forecast
+    b = jnp.einsum(
+        "bnp,bn,bn->np", jac, r_inv, y_tilde, precision=hi
+    ) + jnp.einsum("npq,nq->np", p_inv_forecast, x_forecast, precision=hi)
     return a.astype(f32), b.astype(f32)
 
 
@@ -103,6 +112,9 @@ def iterated_solve(
     tol: float = CONVERGENCE_TOL,
     min_iterations: int = MIN_ITERATIONS,
     max_iterations: int = MAX_ITERATIONS,
+    relaxation: float = 1.0,
+    state_bounds: Any = None,
+    norm_denominator: Any = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, SolveDiagnostics]:
     """Gauss-Newton relinearisation loop as a single ``lax.while_loop``.
 
@@ -113,9 +125,29 @@ def iterated_solve(
     (the norm is global, exactly like the reference's single scalar norm at
     ``linear_kf.py:293``).
 
+    ``relaxation`` < 1 applies damped Gauss-Newton
+    (``x <- x_prev + relaxation * (x_solve - x_prev)``), which stabilises
+    stiff nonlinear operators the undamped reference loop oscillates on
+    (it bails at the cap and silently returns the last iterate); 1.0
+    reproduces the reference exactly.
+
+    ``state_bounds`` — an optional ``(lower, upper)`` pair of per-parameter
+    arrays — projects each iterate into the physical domain.  Without it a
+    Gauss-Newton step can leave the region where the operator's gradients
+    are meaningful (e.g. negative transformed LAI), after which the
+    iteration diverges; the reference has no safeguard and silently emits
+    the diverged state.  Operators declare their domains via
+    ``ObservationModel.state_bounds``.
+
+    ``norm_denominator`` — element count used to normalise the convergence
+    norm.  Callers with padded pixel batches must pass the *valid* element
+    count (n_valid * p): padding pixels contribute zero step, so dividing by
+    the padded size would loosen the tolerance by n_pad/n_valid relative to
+    the reference's ``len(x_analysis)`` (``linear_kf.py:296``).
+
     Returns ``(x_analysis, p_inv_analysis, diagnostics)``.
     """
-    numel = x_forecast.size
+    numel = x_forecast.size if norm_denominator is None else norm_denominator
 
     def one_solve(x_prev):
         lin = _call_linearize(linearize, operator_params, x_prev)
@@ -130,6 +162,10 @@ def iterated_solve(
     def body(carry):
         x_prev, _a, _h0, _jac, n_done, _norm = carry
         x_new, a, lin = one_solve(x_prev)
+        x_new = x_prev + relaxation * (x_new - x_prev)
+        if state_bounds is not None:
+            lo, hi = state_bounds
+            x_new = jnp.clip(x_new, lo, hi)
         norm = jnp.linalg.norm(x_new - x_prev) / numel
         return (x_new, a, lin.h0, lin.jac, n_done + 1, norm)
 
@@ -204,6 +240,7 @@ def assimilate_date_jit(
     x_forecast: jnp.ndarray,
     p_inv_forecast: jnp.ndarray,
     operator_params: Any = None,
+    solver_options: Any = None,
 ):
     """Jitted entry point for one date's full multi-band assimilation.
 
@@ -212,6 +249,7 @@ def assimilate_date_jit(
     ``operator_params`` (a traced pytree) — a fresh closure per date would
     recompile the whole multi-iteration program every timestep.
     """
+    opts = dict(solver_options or {})
     return iterated_solve(
-        linearize, obs, x_forecast, p_inv_forecast, operator_params
+        linearize, obs, x_forecast, p_inv_forecast, operator_params, **opts
     )

@@ -1,0 +1,13 @@
+"""Filter orchestration: the engine around the math core."""
+
+from .checkpoint import Checkpointer
+from .filter import KalmanFilter
+from .priors import (
+    PROSAIL_PARAMETER_LIST,
+    TIP_PARAMETER_LIST,
+    FixedGaussianPrior,
+    jrc_prior,
+    sail_prior,
+)
+from .protocols import DateObservation, ObservationSource, OutputWriter, Prior
+from .state import PixelGather, make_pixel_gather

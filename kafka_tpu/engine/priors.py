@@ -1,0 +1,76 @@
+"""Prior objects: batched equivalents of the drivers' prior classes.
+
+The reference defines near-identical prior classes per driver — ``JRCPrior``
+(``/root/reference/kafka_test.py:78-133``) and ``SAILPrior``
+(``kafka_test_S2.py:77-118``) — each tiling a fixed per-pixel mean/inverse
+covariance over the masked pixels with ``block_diag``.  Here that is one
+``FixedGaussianPrior`` over any ``PixelPrior``; the published constants ship
+as ready-made constructors.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.propagators import PixelPrior, broadcast_prior, tip_prior
+from .state import PixelGather
+
+# The 10-parameter PROSAIL state of the S2 driver (kafka_test_S2.py:136-137).
+PROSAIL_PARAMETER_LIST = (
+    "n", "cab", "car", "cbrown", "cw", "cm", "lai", "ala", "bsoil", "psoil",
+)
+
+# The 7-parameter TIP state of the MODIS drivers (kafka_test.py:159-160).
+TIP_PARAMETER_LIST = (
+    "w_vis", "x_vis", "a_vis", "w_nir", "x_nir", "a_nir", "TeLAI",
+)
+
+
+class FixedGaussianPrior:
+    """A time-invariant i.i.d.-per-pixel Gaussian prior."""
+
+    def __init__(self, prior: PixelPrior,
+                 parameter_list: Sequence[str]):
+        self.prior = prior
+        self.parameter_list = tuple(parameter_list)
+
+    def process_prior(self, date: Optional[datetime.datetime],
+                      gather: PixelGather) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        return broadcast_prior(self.prior, gather.n_pad)
+
+
+def sail_prior() -> FixedGaussianPrior:
+    """The S2/PROSAIL prior with the reference's transformed-space means and
+    sigmas (``kafka_test_S2.py:84-92``): exponential transforms for the
+    absorption/structure parameters, ``lai`` slot in TLAI space."""
+    mean = np.array([
+        2.1, np.exp(-60.0 / 100.0), np.exp(-7.0 / 100.0), 0.1,
+        np.exp(-50 * 0.0176), np.exp(-100.0 * 0.002), np.exp(-4.0 / 2.0),
+        70.0 / 90.0, 0.5, 0.9,
+    ], np.float32)
+    sigma = np.array(
+        [0.01, 0.2, 0.01, 0.05, 0.01, 0.01, 0.50, 0.1, 0.1, 0.1], np.float32
+    )
+    cov = np.diag(sigma**2).astype(np.float32)
+    inv_cov = np.diag(1.0 / sigma**2).astype(np.float32)
+    prior = PixelPrior(
+        mean=jnp.asarray(mean), cov=jnp.asarray(cov),
+        inv_cov=jnp.asarray(inv_cov),
+    )
+    return FixedGaussianPrior(prior, PROSAIL_PARAMETER_LIST)
+
+
+def jrc_prior() -> FixedGaussianPrior:
+    """The MODIS/TIP prior (``kafka_test.py:110-125``; same constants as
+    ``kf_tools.tip_prior`` but with mean LAI 2.0 in transformed space)."""
+    base = tip_prior()
+    mean = np.asarray(base.mean).copy()
+    mean[6] = np.exp(-0.5 * 2.0)  # JRCPrior uses TLAI(2.0), kafka_test.py:113
+    prior = PixelPrior(
+        mean=jnp.asarray(mean), cov=base.cov, inv_cov=base.inv_cov
+    )
+    return FixedGaussianPrior(prior, TIP_PARAMETER_LIST)

@@ -1,0 +1,78 @@
+"""The five injection points, as explicit protocols.
+
+The reference wires ``LinearKalman`` with five pluggable pieces — an
+observations object, an output writer, an observation-operator factory, a
+state-propagation function, and a prior object
+(``/root/reference/kafka/linear_kf.py:59-96``).  This module preserves
+exactly those extension points with array-native signatures (SURVEY.md §1:
+"the new framework should preserve exactly these five extension points").
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any, NamedTuple, Optional, Protocol, Sequence, Tuple,\
+    runtime_checkable
+
+import jax.numpy as jnp
+
+from ..core.types import BandBatch
+from ..obsops.protocol import ObservationModel
+from .state import PixelGather
+
+
+class DateObservation(NamedTuple):
+    """Everything needed to assimilate one acquisition date: the stacked
+    band observations gathered to the pixel batch, the operator that maps
+    state to those bands, and the operator's per-date aux data (angles,
+    emulator weights...).  Replaces the reference's per-band
+    ``get_band_data`` tuples + pickled emulator
+    (``Sentinel2_Observations.py:148-185``)."""
+
+    bands: BandBatch
+    operator: ObservationModel
+    aux: Any
+
+
+@runtime_checkable
+class ObservationSource(Protocol):
+    """Injection point 1 — the observations object.
+
+    ``dates`` lists available acquisitions (reference: ``.dates``,
+    ``observations.py:241-249``); ``get_observations`` gathers one date's
+    rasters into the fixed pixel batch."""
+
+    @property
+    def dates(self) -> Sequence[datetime.datetime]: ...
+
+    def get_observations(self, date: datetime.datetime,
+                         gather: PixelGather) -> DateObservation: ...
+
+
+@runtime_checkable
+class OutputWriter(Protocol):
+    """Injection point 2 — the output sink.  Mirrors
+    ``KafkaOutput.dump_data`` (``observations.py:354-394``) with batched
+    arrays: ``x`` (n_pad, p) and ``p_inv_diag`` (n_pad, p)."""
+
+    def dump_data(self, timestep: datetime.datetime, x, p_inv_diag,
+                  gather: PixelGather, parameter_list: Sequence[str]) -> None:
+        ...
+
+
+@runtime_checkable
+class Prior(Protocol):
+    """Injection point 5 — the prior object.  Mirrors
+    ``prior.process_prior(date, inv_cov=True)``
+    (``kafka_test_S2.py:106-118``) in batched layout."""
+
+    def process_prior(self, date: Optional[datetime.datetime],
+                      gather: PixelGather) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        ...
+
+
+# Injection points 3 and 4 are plain callables:
+#  - the observation operator (an ObservationModel instance, carried inside
+#    DateObservation so different dates/sensors can use different operators);
+#  - the state propagator, any callable with the propagator contract of
+#    kafka_tpu.core.propagators.

@@ -1,0 +1,5 @@
+"""Host-side raster I/O: GeoTIFF codec, output writers, chunk tiling."""
+
+from .geotiff import GeoInfo, TiffInfo, read_geotiff, read_info, write_geotiff
+from .output import GeoTIFFOutput
+from .tiling import Chunk, chunk_geotransform, chunk_mask, get_chunks

@@ -1,0 +1,530 @@
+"""Self-contained GeoTIFF reader/writer.
+
+The reference delegates all raster I/O to GDAL's C++ stack (readers in
+``/root/reference/kafka/input_output/``; writer ``KafkaOutput.dump_data``,
+``observations.py:354-394``).  This environment has no GDAL, and the TPU
+build owns its raster path anyway (SURVEY.md §2.2): this module implements
+the TIFF 6.0 container (classic + BigTIFF) with striped/tiled layout,
+DEFLATE (zlib) compression, horizontal-differencing predictor, and the
+GeoTIFF tags needed for georeferenced outputs (pixel scale, tiepoint, geokey
+directory, projection citation) plus GDAL-style nodata.
+
+Container parsing/assembly is pure Python + NumPy; the per-tile
+compress/decompress/predictor hot path is dispatched to the C++ codec in
+``kafka_tpu/native`` (thread-pooled zlib) when built, else Python zlib.
+
+Capabilities: float32/float64/uint8/int16/uint16/int32/uint32 samples,
+single- or multi-band (band-interleaved-by-pixel), compression none/deflate
+(8)/adobe-deflate(32946), predictor 1/2.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import native_codec
+
+# --- TIFF constants -------------------------------------------------------
+
+_TYPE_SIZES = {1: 1, 2: 1, 3: 2, 4: 4, 5: 8, 6: 1, 7: 1, 8: 2, 9: 4,
+               10: 8, 11: 4, 12: 8, 16: 8, 17: 8, 18: 8}
+_TYPE_FMT = {1: "B", 3: "H", 4: "I", 8: "h", 9: "i", 11: "f", 12: "d",
+             16: "Q", 17: "q"}
+
+T_WIDTH, T_HEIGHT = 256, 257
+T_BITS, T_COMPRESSION, T_PHOTOMETRIC = 258, 259, 262
+T_STRIP_OFFSETS, T_SAMPLES_PER_PIXEL, T_ROWS_PER_STRIP = 273, 277, 278
+T_STRIP_BYTECOUNTS = 279
+T_PLANAR = 284
+T_PREDICTOR = 317
+T_TILE_WIDTH, T_TILE_HEIGHT, T_TILE_OFFSETS, T_TILE_BYTECOUNTS = (
+    322, 323, 324, 325
+)
+T_SAMPLE_FORMAT = 339
+T_PIXEL_SCALE, T_TIEPOINT = 33550, 33922
+T_GEO_KEYS, T_GEO_DOUBLES, T_GEO_ASCII = 34735, 34736, 34737
+T_GDAL_METADATA, T_GDAL_NODATA = 42112, 42113
+
+_SAMPLE_DTYPES = {
+    (8, 1): np.uint8, (16, 1): np.uint16, (32, 1): np.uint32,
+    (8, 2): np.int8, (16, 2): np.int16, (32, 2): np.int32,
+    (32, 3): np.float32, (64, 3): np.float64,
+}
+
+
+@dataclass
+class GeoInfo:
+    """Georeferencing: GDAL-style geotransform + projection description.
+
+    ``geotransform`` = (origin_x, pixel_w, 0, origin_y, 0, -pixel_h), the
+    exact 6-tuple contract of the reference's ``define_output``
+    (``Sentinel2_Observations.py:100-113``).  ``projection`` is stored in
+    the GeoASCII tag; EPSG codes go in the geokey directory.
+    """
+
+    geotransform: Tuple[float, ...] = (0.0, 1.0, 0.0, 0.0, 0.0, -1.0)
+    projection: str = ""
+    epsg: Optional[int] = None
+    nodata: Optional[float] = None
+
+
+@dataclass
+class TiffInfo:
+    width: int
+    height: int
+    n_bands: int
+    dtype: np.dtype
+    compression: int
+    predictor: int
+    tiled: bool
+    tile_shape: Optional[Tuple[int, int]]
+    geo: GeoInfo
+    tags: Dict[int, tuple] = field(default_factory=dict)
+    #: byte order of the file ("<" or ">") — sample data in an "MM" TIFF
+    #: must be decoded big-endian regardless of host order.
+    byte_order: str = "<"
+
+
+# --- reading --------------------------------------------------------------
+
+
+def _read_ifd(buf, offset, endian, big):
+    entries = {}
+    if big:
+        (count,) = struct.unpack_from(endian + "Q", buf, offset)
+        pos = offset + 8
+        entry_size, cnt_fmt = 20, "Q"
+    else:
+        (count,) = struct.unpack_from(endian + "H", buf, offset)
+        pos = offset + 2
+        entry_size, cnt_fmt = 12, "I"
+    for i in range(count):
+        tag, typ = struct.unpack_from(endian + "HH", buf, pos)
+        (n,) = struct.unpack_from(endian + cnt_fmt, buf, pos + 4)
+        val_off = pos + (12 if big else 8)
+        size = _TYPE_SIZES.get(typ, 1) * n
+        inline = 8 if big else 4
+        if size <= inline:
+            data_pos = val_off
+        else:
+            (data_pos,) = struct.unpack_from(
+                endian + ("Q" if big else "I"), buf, val_off
+            )
+        if typ in (2, 7):  # ascii / undefined
+            values = bytes(buf[data_pos:data_pos + n])
+        elif typ == 5 or typ == 10:  # rational
+            raw = struct.unpack_from(endian + ("iI"[typ == 5] * 2 * n),
+                                     buf, data_pos)
+            values = tuple(raw[2 * i] / max(raw[2 * i + 1], 1)
+                           for i in range(n))
+        else:
+            fmt = _TYPE_FMT.get(typ)
+            if fmt is None:
+                pos += entry_size
+                continue
+            values = struct.unpack_from(endian + fmt * n, buf, data_pos)
+        entries[tag] = values
+        pos += entry_size
+    (next_ifd,) = struct.unpack_from(
+        endian + ("Q" if big else "I"), buf, pos
+    )
+    return entries, next_ifd
+
+
+def _tag1(tags, tag, default=None):
+    v = tags.get(tag)
+    if v is None:
+        return default
+    return v[0] if isinstance(v, tuple) else v
+
+
+def read_info(path: str) -> TiffInfo:
+    with open(path, "rb") as f:
+        buf = f.read()
+    return _parse_info(buf)[0]
+
+
+def _parse_info(buf):
+    endian = {b"II": "<", b"MM": ">"}.get(bytes(buf[:2]))
+    if endian is None:
+        raise ValueError("not a TIFF file")
+    magic = struct.unpack_from(endian + "H", buf, 2)[0]
+    if magic == 42:
+        big = False
+        (ifd_off,) = struct.unpack_from(endian + "I", buf, 4)
+    elif magic == 43:
+        big = True
+        (ifd_off,) = struct.unpack_from(endian + "Q", buf, 8)
+    else:
+        raise ValueError("bad TIFF magic %d" % magic)
+    tags, _ = _read_ifd(buf, ifd_off, endian, big)
+
+    width = _tag1(tags, T_WIDTH)
+    height = _tag1(tags, T_HEIGHT)
+    n_bands = _tag1(tags, T_SAMPLES_PER_PIXEL, 1)
+    bits = _tag1(tags, T_BITS, 8)
+    fmt = _tag1(tags, T_SAMPLE_FORMAT, 1)
+    dtype = np.dtype(_SAMPLE_DTYPES.get((bits, fmt), np.uint8))
+    compression = _tag1(tags, T_COMPRESSION, 1)
+    predictor = _tag1(tags, T_PREDICTOR, 1)
+    tiled = T_TILE_OFFSETS in tags
+
+    geo = GeoInfo()
+    if T_PIXEL_SCALE in tags and T_TIEPOINT in tags:
+        sx, sy = tags[T_PIXEL_SCALE][0], tags[T_PIXEL_SCALE][1]
+        tp = tags[T_TIEPOINT]
+        # tiepoint: (i, j, k, x, y, z) raster->model
+        ox = tp[3] - tp[0] * sx
+        oy = tp[4] + tp[1] * sy
+        geo.geotransform = (ox, sx, 0.0, oy, 0.0, -sy)
+    if T_GEO_ASCII in tags:
+        geo.projection = tags[T_GEO_ASCII].rstrip(b"\x00|").decode(
+            "ascii", "replace"
+        )
+    if T_GEO_KEYS in tags:
+        keys = tags[T_GEO_KEYS]
+        for i in range(4, len(keys), 4):
+            key_id, loc, cnt, val = keys[i:i + 4]
+            if key_id in (3072, 2048) and loc == 0:  # Projected/Geog CS
+                geo.epsg = int(val)
+    if T_GDAL_NODATA in tags:
+        try:
+            geo.nodata = float(
+                tags[T_GDAL_NODATA].rstrip(b"\x00").strip()
+            )
+        except ValueError:
+            pass
+
+    info = TiffInfo(
+        width=int(width), height=int(height), n_bands=int(n_bands),
+        dtype=dtype, compression=int(compression), predictor=int(predictor),
+        tiled=tiled,
+        tile_shape=(
+            (int(_tag1(tags, T_TILE_HEIGHT)), int(_tag1(tags, T_TILE_WIDTH)))
+            if tiled else None
+        ),
+        geo=geo, tags=tags, byte_order=endian,
+    )
+    return info, endian, big
+
+
+def _decode_segments(segments, info, seg_shape):
+    """Decompress + de-predict a list of raw byte segments into arrays of
+    ``seg_shape`` (rows, cols, bands)."""
+    rows, cols = seg_shape
+    itemsize = info.dtype.itemsize
+    expected = rows * cols * info.n_bands * itemsize
+    if info.compression in (8, 32946):
+        raw = native_codec.inflate_many(segments, expected)
+    elif info.compression == 1:
+        raw = [bytes(s) for s in segments]
+    elif info.compression == 5:
+        raw = [_lzw_decode(bytes(s)) for s in segments]
+    else:
+        raise NotImplementedError(
+            "TIFF compression %d not supported" % info.compression
+        )
+    # Decode with the FILE's byte order, then return native-endian arrays.
+    file_dtype = info.dtype.newbyteorder(info.byte_order)
+    out = []
+    for r in raw:
+        arr = np.frombuffer(r[:expected].ljust(expected, b"\x00"),
+                            dtype=file_dtype)
+        arr = arr.reshape(rows, cols, info.n_bands).astype(info.dtype)
+        if info.predictor == 2:
+            np.cumsum(arr, axis=1, out=arr, dtype=arr.dtype)
+        out.append(arr)
+    return out
+
+
+def _lzw_decode(data: bytes) -> bytes:
+    """TIFF LZW (MSB-first, early-change) — needed for fixtures written by
+    GDAL's default creation options."""
+    CLEAR, EOI = 256, 257
+    out = bytearray()
+    table: List[bytes] = []
+
+    def reset():
+        nonlocal table
+        table = [bytes([i]) for i in range(256)] + [b"", b""]
+
+    reset()
+    bitpos = 0
+    nbits = 9
+    prev = b""
+    total_bits = len(data) * 8
+    while bitpos + nbits <= total_bits:
+        byte_idx = bitpos >> 3
+        chunk = int.from_bytes(
+            data[byte_idx:byte_idx + 4].ljust(4, b"\x00"), "big"
+        )
+        code = (chunk >> (32 - nbits - (bitpos & 7))) & ((1 << nbits) - 1)
+        bitpos += nbits
+        if code == EOI:
+            break
+        if code == CLEAR:
+            reset()
+            nbits = 9
+            prev = b""
+            continue
+        if prev == b"":
+            entry = table[code]
+        elif code < len(table):
+            entry = table[code]
+            table.append(prev + entry[:1])
+        else:
+            entry = prev + prev[:1]
+            table.append(entry)
+        out += entry
+        prev = entry
+        if len(table) >= (1 << nbits) - 1 and nbits < 12:
+            nbits += 1
+    return bytes(out)
+
+
+def read_geotiff(path: str) -> Tuple[np.ndarray, TiffInfo]:
+    """Read a GeoTIFF.  Returns ``(array, info)`` with array shaped
+    (height, width) single-band or (height, width, bands)."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    info, endian, big = _parse_info(buf)
+    tags = info.tags
+    h, w, nb = info.height, info.width, info.n_bands
+    out = np.zeros((h, w, nb), info.dtype)
+    if info.tiled:
+        th, tw = info.tile_shape
+        offsets = tags[T_TILE_OFFSETS]
+        counts = tags[T_TILE_BYTECOUNTS]
+        tiles_across = (w + tw - 1) // tw
+        segs = [buf[o:o + c] for o, c in zip(offsets, counts)]
+        arrays = _decode_segments(segs, info, (th, tw))
+        for idx, arr in enumerate(arrays):
+            ty, tx = divmod(idx, tiles_across)
+            y0, x0 = ty * th, tx * tw
+            ys, xs = min(th, h - y0), min(tw, w - x0)
+            if ys <= 0 or xs <= 0:
+                continue
+            out[y0:y0 + ys, x0:x0 + xs] = arr[:ys, :xs]
+    else:
+        rps = int(_tag1(tags, T_ROWS_PER_STRIP, h))
+        offsets = tags[T_STRIP_OFFSETS]
+        counts = tags.get(
+            T_STRIP_BYTECOUNTS, tuple([len(buf)] * len(offsets))
+        )
+        for si, (o, c) in enumerate(zip(offsets, counts)):
+            y0 = si * rps
+            rows = min(rps, h - y0)
+            if rows <= 0:
+                continue
+            arr = _decode_segments([buf[o:o + c]], info, (rows, w))[0]
+            out[y0:y0 + rows] = arr
+    if nb == 1:
+        out = out[:, :, 0]
+    return out, info
+
+
+# --- writing --------------------------------------------------------------
+
+
+def _geo_tags(geo: GeoInfo):
+    ox, sx, _, oy, _, nsy = geo.geotransform
+    tags = [
+        (T_PIXEL_SCALE, 12, (float(sx), float(abs(nsy)), 0.0)),
+        (T_TIEPOINT, 12, (0.0, 0.0, 0.0, float(ox), float(oy), 0.0)),
+    ]
+    keys = [1, 1, 0, 0]  # version, rev, minor, n_keys (patched below)
+    n_keys = 0
+    # Geographic CRS codes (EPSG 4000-4999, e.g. 4326/WGS84) get
+    # ModelTypeGeographic + GeographicTypeGeoKey; everything else is
+    # treated as projected (ProjectedCSTypeGeoKey).
+    geographic = geo.epsg is not None and 4000 <= geo.epsg < 5000
+    keys += [1024, 0, 1, 2 if geographic else 1]
+    n_keys += 1
+    keys += [1025, 0, 1, 1]  # RasterPixelIsArea
+    n_keys += 1
+    if geo.epsg is not None:
+        keys += [2048 if geographic else 3072, 0, 1, int(geo.epsg)]
+        n_keys += 1
+    ascii_blob = b""
+    if geo.projection:
+        text = geo.projection.encode("ascii", "replace") + b"|"
+        keys += [1026, T_GEO_ASCII, len(text), 0]
+        n_keys += 1
+        ascii_blob = text
+    keys[3] = n_keys
+    tags.append((T_GEO_KEYS, 3, tuple(keys)))
+    if ascii_blob:
+        tags.append((T_GEO_ASCII, 2, ascii_blob + b"\x00"))
+    if geo.nodata is not None:
+        tags.append(
+            (T_GDAL_NODATA, 2, (repr(float(geo.nodata)).encode() + b"\x00"))
+        )
+    return tags
+
+
+_DTYPE_TO_TAGS = {
+    np.dtype(np.uint8): (8, 1), np.dtype(np.uint16): (16, 1),
+    np.dtype(np.uint32): (32, 1), np.dtype(np.int16): (16, 2),
+    np.dtype(np.int32): (32, 2), np.dtype(np.float32): (32, 3),
+    np.dtype(np.float64): (64, 3),
+}
+
+
+def write_geotiff(
+    path: str,
+    array: np.ndarray,
+    geo: Optional[GeoInfo] = None,
+    tile_size: int = 256,
+    compress: bool = True,
+    level: int = 6,
+    predictor: int = 1,
+    bigtiff: Optional[bool] = None,
+) -> None:
+    """Write a single/multi-band GeoTIFF: tiled, DEFLATE by default — the
+    writer-side contract of the reference's ``KafkaOutput``
+    (``observations.py:360-365``: COMPRESS=DEFLATE, TILED=YES, PREDICTOR=1,
+    BIGTIFF=YES; BigTIFF here switches on automatically past 3.5 GB or can
+    be forced)."""
+    geo = geo or GeoInfo()
+    arr = np.asarray(array)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    h, w, nb = arr.shape
+    dtype = arr.dtype
+    if dtype not in _DTYPE_TO_TAGS:
+        arr = arr.astype(np.float32)
+        dtype = arr.dtype
+    bits, fmt = _DTYPE_TO_TAGS[dtype]
+
+    th = tw = tile_size
+    tiles_down = (h + th - 1) // th
+    tiles_across = (w + tw - 1) // tw
+    segs = []
+    for ty in range(tiles_down):
+        for tx in range(tiles_across):
+            tile = np.zeros((th, tw, nb), dtype)
+            y0, x0 = ty * th, tx * tw
+            ys, xs = min(th, h - y0), min(tw, w - x0)
+            tile[:ys, :xs] = arr[y0:y0 + ys, x0:x0 + xs]
+            if predictor == 2:
+                tile = np.diff(
+                    np.concatenate(
+                        [np.zeros((th, 1, nb), dtype), tile], axis=1
+                    ),
+                    axis=1,
+                ).astype(dtype)
+            segs.append(tile.tobytes())
+    if compress:
+        segs = native_codec.deflate_many(segs, level)
+        compression = 8
+    else:
+        compression = 1
+
+    data_size = sum(len(s) for s in segs)
+    if bigtiff is None:
+        bigtiff = data_size > 3_500_000_000
+    big = bool(bigtiff)
+
+    entries = [
+        (T_WIDTH, 3, (w,)), (T_HEIGHT, 3, (h,)),
+        (T_BITS, 3, (bits,) * nb),
+        (T_COMPRESSION, 3, (compression,)),
+        (T_PHOTOMETRIC, 3, (1,)),
+        (T_SAMPLES_PER_PIXEL, 3, (nb,)),
+        (T_PLANAR, 3, (1,)),
+        (T_PREDICTOR, 3, (predictor,)),
+        (T_TILE_WIDTH, 3, (tw,)), (T_TILE_HEIGHT, 3, (th,)),
+        (T_SAMPLE_FORMAT, 3, (fmt,) * nb),
+    ]
+    entries += _geo_tags(geo)
+
+    off_type = 16 if big else 4  # LONG8 vs LONG
+    entries.append((T_TILE_OFFSETS, off_type, None))     # patched later
+    entries.append((T_TILE_BYTECOUNTS, off_type, None))
+    entries.sort(key=lambda e: e[0])
+
+    endian = "<"
+    header_size = 16 if big else 8
+    ifd_entry = 20 if big else 12
+    ifd_header = 8 if big else 2
+    ifd_tail = 8 if big else 4
+    inline_max = 8 if big else 4
+    n = len(entries)
+    ifd_size = ifd_header + n * ifd_entry + ifd_tail
+
+    # layout: header | IFD | overflow tag data | segment data
+    overflow = []
+    overflow_pos = header_size + ifd_size
+
+    def value_bytes(typ, values):
+        if typ == 2 or typ == 7:
+            return bytes(values)
+        fmt_ch = {3: "H", 4: "I", 12: "d", 16: "Q"}[typ]
+        return struct.pack(endian + fmt_ch * len(values), *values)
+
+    # first pass to size overflow area (tile offsets resolved after)
+    seg_count = len(segs)
+    placeholder = {
+        T_TILE_OFFSETS: (off_type, tuple([0] * seg_count)),
+        T_TILE_BYTECOUNTS: (off_type, tuple(len(s) for s in segs)),
+    }
+    sized = []
+    for tag, typ, values in entries:
+        if values is None:
+            typ, values = placeholder[tag]
+        raw = value_bytes(typ, values)
+        count = (
+            len(values) if typ in (2, 7)
+            else len(values)
+        )
+        sized.append((tag, typ, count, raw))
+        if len(raw) > inline_max:
+            overflow.append(len(raw))
+    data_start = overflow_pos + sum((s + 1) & ~1 for s in overflow)
+
+    # resolve real tile offsets
+    offsets = []
+    pos = data_start
+    for s in segs:
+        offsets.append(pos)
+        pos += len(s)
+    final = []
+    for tag, typ, count, raw in sized:
+        if tag == T_TILE_OFFSETS:
+            raw = value_bytes(typ, tuple(offsets))
+        final.append((tag, typ, count, raw))
+
+    with open(path, "wb") as f:
+        if big:
+            f.write(struct.pack(endian + "2sHHHQ", b"II", 43, 8, 0,
+                                header_size))
+        else:
+            f.write(struct.pack(endian + "2sHI", b"II", 42, header_size))
+        # IFD
+        if big:
+            f.write(struct.pack(endian + "Q", n))
+        else:
+            f.write(struct.pack(endian + "H", n))
+        ov_pos = overflow_pos
+        ov_chunks = []
+        for tag, typ, count, raw in final:
+            f.write(struct.pack(endian + "HH", tag, typ))
+            f.write(struct.pack(endian + ("Q" if big else "I"), count))
+            if len(raw) <= inline_max:
+                f.write(raw.ljust(inline_max, b"\x00"))
+            else:
+                f.write(struct.pack(endian + ("Q" if big else "I"), ov_pos))
+                ov_chunks.append((ov_pos, raw))
+                ov_pos += (len(raw) + 1) & ~1
+        f.write(struct.pack(endian + ("Q" if big else "I"), 0))  # next IFD
+        for pos_, raw in ov_chunks:
+            f.seek(pos_)
+            f.write(raw)
+        f.seek(data_start)
+        for s in segs:
+            f.write(s)

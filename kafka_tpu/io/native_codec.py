@@ -1,0 +1,44 @@
+"""Dispatch layer for the raster codec hot path.
+
+Batch DEFLATE encode/decode of TIFF tiles.  Uses the C++ thread-pooled codec
+(``kafka_tpu/native/rasterkit.cpp``) when its shared library is built —
+decoding a 10980x10980 tile-year means ~10^5 tile inflations, which the
+native pool does in parallel without the GIL — and falls back to Python's
+zlib (itself C, but serial) otherwise.
+
+Build the native library with ``make -C kafka_tpu/native`` (done
+automatically by ``kafka_tpu.native.ensure_built()``).
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import List, Sequence
+
+_native = None
+
+
+def _load_native():
+    global _native
+    if _native is None:
+        try:
+            from ..native import load_library
+
+            _native = load_library()
+        except Exception:
+            _native = False
+    return _native
+
+
+def inflate_many(segments: Sequence[bytes], expected_size: int) -> List[bytes]:
+    lib = _load_native()
+    if lib:
+        return lib.inflate_many(segments, expected_size)
+    return [zlib.decompress(bytes(s)) for s in segments]
+
+
+def deflate_many(segments: Sequence[bytes], level: int = 6) -> List[bytes]:
+    lib = _load_native()
+    if lib:
+        return lib.deflate_many(segments, level)
+    return [zlib.compress(s, level) for s in segments]

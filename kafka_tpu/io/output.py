@@ -1,0 +1,122 @@
+"""Output writers.
+
+``GeoTIFFOutput`` matches the reference ``KafkaOutput`` contract
+(``/root/reference/kafka/input_output/observations.py:338-394``): one
+GeoTIFF per parameter per timestep named ``{param}_{A%Y%j}[_{prefix}].tif``
+plus ``..._unc.tif`` holding ``1/sqrt(diag(P^-1))``, DEFLATE-compressed and
+tiled, unmasked pixels zero.  Writes can optionally run on a background
+thread so device compute never waits on disk (the reference writes
+synchronously inside the time loop, ``linear_kf.py:210-212``).
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import queue
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..engine.state import PixelGather
+from .geotiff import GeoInfo, write_geotiff
+
+
+class GeoTIFFOutput:
+    def __init__(
+        self,
+        parameter_list: Sequence[str],
+        geotransform,
+        projection: str = "",
+        folder: str = ".",
+        prefix: Optional[str] = None,
+        epsg: Optional[int] = None,
+        async_writes: bool = False,
+    ):
+        self.parameter_list = tuple(parameter_list)
+        self.geo = GeoInfo(
+            geotransform=tuple(geotransform), projection=projection,
+            epsg=epsg,
+        )
+        self.folder = folder
+        self.prefix = prefix
+        os.makedirs(folder, exist_ok=True)
+        self._queue: Optional[queue.Queue] = None
+        self._worker: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        if async_writes:
+            self._queue = queue.Queue(maxsize=4)
+            self._worker = threading.Thread(
+                target=self._drain, daemon=True
+            )
+            self._worker.start()
+
+    def _fname(self, param: str, timestep: datetime.datetime,
+               unc: bool) -> str:
+        date = timestep.strftime("A%Y%j")
+        parts = [param, date]
+        if self.prefix is not None:
+            parts.append(str(self.prefix))
+        if unc:
+            parts.append("unc")
+        return os.path.join(self.folder, "_".join(parts) + ".tif")
+
+    def _write_all(self, timestep, x, p_inv_diag, gather, parameter_list):
+        x = np.asarray(x)
+        for ii, param in enumerate(parameter_list):
+            raster = gather.scatter(x[:, ii].astype(np.float32))
+            write_geotiff(self._fname(param, timestep, False), raster,
+                          self.geo)
+        if p_inv_diag is None:
+            return
+        p_inv_diag = np.asarray(p_inv_diag)
+        for ii, param in enumerate(parameter_list):
+            sigma = 1.0 / np.sqrt(np.maximum(p_inv_diag[:, ii], 1e-30))
+            raster = gather.scatter(sigma.astype(np.float32))
+            write_geotiff(self._fname(param, timestep, True), raster,
+                          self.geo)
+
+    def dump_data(self, timestep, x, p_inv_diag, gather: PixelGather,
+                  parameter_list) -> None:
+        self._raise_pending()
+        if self._queue is not None:
+            self._queue.put(
+                (timestep, np.asarray(x).copy(),
+                 None if p_inv_diag is None else np.asarray(p_inv_diag).copy(),
+                 gather, tuple(parameter_list))
+            )
+        else:
+            self._write_all(timestep, x, p_inv_diag, gather, parameter_list)
+
+    def _drain(self):
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            try:
+                self._write_all(*item)
+            except Exception as exc:  # surfaced on next dump/flush/close
+                self._error = exc
+            finally:
+                self._queue.task_done()
+
+    def _raise_pending(self):
+        if self._error is not None:
+            exc, self._error = self._error, None
+            raise RuntimeError(
+                "asynchronous GeoTIFF write failed"
+            ) from exc
+
+    def flush(self):
+        """Block until queued writes are on disk (raises if any failed)."""
+        if self._queue is not None:
+            self._queue.join()
+        self._raise_pending()
+
+    def close(self):
+        if self._queue is not None:
+            self.flush()
+            self._queue.put(None)
+            self._worker.join()
+            self._queue = None

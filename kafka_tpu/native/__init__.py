@@ -1,0 +1,122 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+``rasterkit``: thread-pooled TIFF tile codec (zlib inflate/deflate +
+predictor), the GDAL-stack replacement for the raster hot path.  Built on
+demand with the bundled Makefile; all callers fall back to pure Python when
+no compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Sequence
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "librasterkit.so")
+
+_DEFAULT_THREADS = min(16, os.cpu_count() or 1)
+
+
+def ensure_built(quiet: bool = True) -> bool:
+    """Compile librasterkit.so if missing.  Returns True when available."""
+    if os.path.exists(_SO):
+        return True
+    try:
+        subprocess.run(
+            ["make", "-C", _DIR],
+            check=True,
+            capture_output=quiet,
+        )
+    except Exception:
+        return False
+    return os.path.exists(_SO)
+
+
+class RasterKit:
+    """ctypes wrapper over librasterkit with list-of-bytes interfaces."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.rk_inflate_batch.restype = ctypes.c_int
+        lib.rk_inflate_batch.argtypes = [
+            ctypes.c_int64, ctypes.POINTER(u8p),
+            ctypes.POINTER(ctypes.c_int64), u8p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ]
+        lib.rk_deflate_batch.restype = ctypes.c_int
+        lib.rk_deflate_batch.argtypes = [
+            ctypes.c_int64, ctypes.POINTER(u8p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, u8p,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ]
+
+    @staticmethod
+    def _in_arrays(segments: Sequence[bytes]):
+        n = len(segments)
+        bufs = [ctypes.create_string_buffer(s, len(s)) for s in segments]
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        ptrs = (u8p * n)(
+            *[ctypes.cast(b, u8p) for b in bufs]
+        )
+        sizes = (ctypes.c_int64 * n)(*[len(s) for s in segments])
+        return n, bufs, ptrs, sizes
+
+    def inflate_many(self, segments: Sequence[bytes],
+                     expected_size: int,
+                     n_threads: int = _DEFAULT_THREADS) -> List[bytes]:
+        n, bufs, ptrs, sizes = self._in_arrays(segments)
+        if n == 0:
+            return []
+        stride = int(expected_size)
+        out = ctypes.create_string_buffer(n * stride)
+        out_sizes = (ctypes.c_int64 * n)()
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        rc = self._lib.rk_inflate_batch(
+            n, ptrs, sizes, ctypes.cast(out, u8p), stride, out_sizes,
+            n_threads,
+        )
+        if rc != 0:
+            raise ValueError("zlib inflate failed with code %d" % rc)
+        raw = out.raw  # single copy; .raw copies the whole buffer per access
+        return [
+            raw[i * stride: i * stride + out_sizes[i]] for i in range(n)
+        ]
+
+    def deflate_many(self, segments: Sequence[bytes], level: int = 6,
+                     n_threads: int = _DEFAULT_THREADS) -> List[bytes]:
+        n, bufs, ptrs, sizes = self._in_arrays(segments)
+        if n == 0:
+            return []
+        max_in = max(len(s) for s in segments)
+        # zlib worst case: input + input/1000 + 64
+        stride = max_in + max_in // 1000 + 64
+        out = ctypes.create_string_buffer(n * stride)
+        out_sizes = (ctypes.c_int64 * n)()
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        rc = self._lib.rk_deflate_batch(
+            n, ptrs, sizes, level, ctypes.cast(out, u8p), stride,
+            out_sizes, n_threads,
+        )
+        if rc != 0:
+            raise ValueError("zlib deflate failed with code %d" % rc)
+        raw = out.raw  # single copy; .raw copies the whole buffer per access
+        return [
+            raw[i * stride: i * stride + out_sizes[i]] for i in range(n)
+        ]
+
+
+_loaded: Optional[RasterKit] = None
+
+
+def load_library() -> Optional[RasterKit]:
+    """Load (building if needed) the native codec; None if unavailable."""
+    global _loaded
+    if _loaded is None:
+        if ensure_built():
+            _loaded = RasterKit(ctypes.CDLL(_SO))
+        else:
+            _loaded = False  # type: ignore[assignment]
+    return _loaded or None

@@ -1,0 +1,23 @@
+"""Observation operators: differentiable forward models H(x) with autodiff
+linearisation (the reference's obs-op factories + emulators, re-designed)."""
+
+from .protocol import MappedStateModel, ObservationModel
+from .identity import IdentityOperator
+from .wcm import WCMAux, WCMOperator, WCM_PARAMETERS, wcm_sigma0, validate_state
+from .twostream import (
+    NIR_MAPPER,
+    VIS_MAPPER,
+    TwoStreamOperator,
+    tlai_to_lai,
+    twostream_albedo,
+)
+from .gp import (
+    GPBankOperator,
+    GPParams,
+    fit_gp,
+    gp_predict_pixel,
+    load_gp,
+    save_gp,
+    stack_gp_bank,
+)
+from .mlp import MLPOperator, fit_mlp, mlp_apply

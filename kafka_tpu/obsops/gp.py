@@ -1,0 +1,176 @@
+"""JAX Gaussian-process emulator of expensive radiative-transfer models.
+
+The reference runs pickled ``gp_emulator`` objects per band x geometry
+(``/root/reference/kafka/input_output/Sentinel2_Observations.py:95-98,157-159``)
+whose ``predict`` returns value + gradient and whose ``hessian`` feeds the
+second-order correction (``kf_tools.py:28``).  Those pickles encode a GP
+regression over PROSAIL training runs.  This module is the TPU-native
+equivalent: an ARD-RBF GP whose predictive mean
+
+    m(x*) = k(x*, X) @ alpha,   alpha = (K + sigma_n^2 I)^-1 y
+
+is a pure JAX function — one matvec against the inducing set per pixel, MXU
+friendly — with Jacobian/Hessian by autodiff instead of hand-derived kernel
+derivatives.  ``GPEmulator.fit`` trains from (X, y) samples of any forward
+model, replacing the unpicklable emulator files with a reproducible artifact
+(hyperparameters + training set), saveable as ``.npz``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .protocol import ObservationModel
+
+
+class GPParams(NamedTuple):
+    """Everything the predictive mean needs; a pytree, so it can flow
+    through ``aux`` as traced data (one compiled solve serves any
+    band/geometry emulator of the same shapes)."""
+
+    x_train: jnp.ndarray      # (m, k) inducing inputs
+    alpha: jnp.ndarray        # (m,) precomputed (K + sig^2 I)^-1 y
+    log_lengthscales: jnp.ndarray  # (k,)
+    log_amplitude: jnp.ndarray     # ()
+    y_mean: jnp.ndarray       # () training-target mean (centering)
+
+
+def _kernel_row(params: GPParams, x_star: jnp.ndarray) -> jnp.ndarray:
+    ell = jnp.exp(params.log_lengthscales)
+    d = (params.x_train - x_star) / ell
+    return jnp.exp(params.log_amplitude) * jnp.exp(-0.5 * jnp.sum(d * d, -1))
+
+
+def gp_predict_pixel(params: GPParams, x_star: jnp.ndarray) -> jnp.ndarray:
+    """Predictive mean for one pixel's (k,) input — scalar output."""
+    return _kernel_row(params, x_star) @ params.alpha + params.y_mean
+
+
+def fit_gp(
+    x_train: np.ndarray,
+    y_train: np.ndarray,
+    lengthscales: Optional[np.ndarray] = None,
+    amplitude: float = 1.0,
+    noise: float = 1e-4,
+    optimize: bool = False,
+    steps: int = 200,
+) -> GPParams:
+    """Condition a GP on training samples.
+
+    With ``optimize=True`` the (log) hyperparameters are tuned by Adam on
+    the negative log marginal likelihood; otherwise lengthscales default to
+    per-dimension input std (a solid heuristic for smooth RT models).
+    """
+    x_train = np.asarray(x_train, np.float32)
+    y_train = np.asarray(y_train, np.float32)
+    y_mean = float(y_train.mean())
+    y_c = y_train - y_mean
+    if lengthscales is None:
+        lengthscales = x_train.std(0) + 1e-3
+
+    log_ell = jnp.log(jnp.asarray(lengthscales, jnp.float32))
+    log_amp = jnp.log(jnp.asarray(amplitude, jnp.float32))
+    xt = jnp.asarray(x_train)
+    yt = jnp.asarray(y_c)
+
+    def gram(log_ell, log_amp):
+        ell = jnp.exp(log_ell)
+        z = xt / ell
+        d2 = (
+            jnp.sum(z * z, -1)[:, None]
+            + jnp.sum(z * z, -1)[None, :]
+            - 2.0 * z @ z.T
+        )
+        return jnp.exp(log_amp) * jnp.exp(-0.5 * jnp.maximum(d2, 0.0))
+
+    if optimize:
+        import optax
+
+        def nll(p):
+            k = gram(p["log_ell"], p["log_amp"])
+            k = k + (noise + jnp.exp(p["log_noise"])) * jnp.eye(k.shape[0])
+            chol = jnp.linalg.cholesky(k)
+            w = jax.scipy.linalg.cho_solve((chol, True), yt)
+            return 0.5 * yt @ w + jnp.sum(jnp.log(jnp.diagonal(chol)))
+
+        params = {
+            "log_ell": log_ell,
+            "log_amp": log_amp,
+            "log_noise": jnp.log(jnp.asarray(noise, jnp.float32)),
+        }
+        opt = optax.adam(1e-2)
+        state = opt.init(params)
+        grad_fn = jax.jit(jax.value_and_grad(nll))
+        for _ in range(steps):
+            _, g = grad_fn(params)
+            updates, state = opt.update(g, state)
+            params = optax.apply_updates(params, updates)
+        log_ell, log_amp = params["log_ell"], params["log_amp"]
+        noise = noise + float(np.exp(params["log_noise"]))
+
+    k = gram(log_ell, log_amp) + noise * jnp.eye(x_train.shape[0])
+    chol = jnp.linalg.cholesky(k)
+    alpha = jax.scipy.linalg.cho_solve((chol, True), yt)
+    return GPParams(
+        x_train=xt,
+        alpha=alpha,
+        log_lengthscales=log_ell,
+        log_amplitude=log_amp,
+        y_mean=jnp.asarray(y_mean, jnp.float32),
+    )
+
+
+def save_gp(path: str, params: GPParams) -> None:
+    np.savez(path, **{f: np.asarray(getattr(params, f)) for f in params._fields})
+
+
+def load_gp(path: str) -> GPParams:
+    data = np.load(path)
+    return GPParams(**{f: jnp.asarray(data[f]) for f in GPParams._fields})
+
+
+class GPBankOperator(ObservationModel):
+    """Multi-band observation operator backed by one GP per band.
+
+    ``aux`` carries a ``GPParams`` whose leaves are stacked over a leading
+    band axis (all bands share shapes — same training-set size), so the
+    operator is a single stable callable and per-date emulator selection
+    (the reference picks a pickle per geometry,
+    ``Sentinel2_Observations.py:133-145``) is just swapping traced arrays.
+
+    Optional ``state_mappers`` (n_bands, k) gather a sub-state per band —
+    the reference's ``state_mapper`` pattern for spectral parameters
+    (``inference/utils.py:148-153``).
+    """
+
+    aux_per_pixel = False
+
+    def __init__(self, n_params: int, n_bands: int, state_mappers=None):
+        self.n_params = n_params
+        self.n_bands = n_bands
+        self.mappers = (
+            None if state_mappers is None else jnp.asarray(state_mappers)
+        )
+
+    def forward_pixel(self, aux: GPParams, x_pixel):
+        def one_band(b):
+            params = jax.tree.map(lambda leaf: leaf[b], aux)
+            sub = x_pixel if self.mappers is None else x_pixel[self.mappers[b]]
+            return gp_predict_pixel(params, sub)
+
+        return jnp.stack([one_band(b) for b in range(self.n_bands)])
+
+
+def stack_gp_bank(per_band: list) -> GPParams:
+    """Stack per-band GPParams into the banked layout used by
+    ``GPBankOperator`` (leading band axis on every leaf)."""
+    return GPParams(
+        *[
+            jnp.stack([jnp.asarray(getattr(p, f)) for p in per_band])
+            for f in GPParams._fields
+        ]
+    )

@@ -1,0 +1,23 @@
+"""Identity / selection observation operators for linear testing.
+
+The reference ships an identity operator used for linear sanity checks
+(``/root/reference/kafka/inference/utils.py:119-126``).  ``IdentityOperator``
+generalises it slightly: each band observes one chosen state parameter
+directly (the plain identity is ``obs_indices = [0]`` on a 1-param state).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .protocol import ObservationModel
+
+
+class IdentityOperator(ObservationModel):
+    def __init__(self, n_params: int, obs_indices=(0,)):
+        self.n_params = n_params
+        self.obs_indices = jnp.asarray(obs_indices)
+        self.n_bands = int(self.obs_indices.shape[0])
+
+    def forward_pixel(self, aux, x_pixel):
+        return x_pixel[self.obs_indices]

@@ -1,0 +1,113 @@
+"""MLP surrogate emulator — the neural alternative to the GP bank.
+
+SURVEY.md §7 "hard parts" (a): reproducing pickled ``gp_emulator``
+predictions may be impossible without the original artifacts; the listed
+fallback is to *train a surrogate of the forward model and validate against
+the emulator outputs*.  This module provides that: a small flax MLP trained
+on samples of any forward function (PROSAIL tables, the two-stream model,
+WCM, ...), used as an ``ObservationModel`` with autodiff Jacobians.  MLP
+inference is pure matmul — the best-mapping operator class for the MXU, and
+typically faster than the GP matvec for large inducing sets.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .protocol import ObservationModel
+
+
+def _init_params(key, sizes: Sequence[int]):
+    params = []
+    for k_in, k_out in zip(sizes[:-1], sizes[1:]):
+        key, sub = jax.random.split(key)
+        w = jax.random.normal(sub, (k_in, k_out)) * jnp.sqrt(2.0 / k_in)
+        params.append({"w": w, "b": jnp.zeros((k_out,))})
+    return params
+
+
+def mlp_apply(params, x):
+    """Forward pass; ``x`` (..., k_in) -> (..., k_out). tanh hidden units
+    keep the surrogate smooth (C-inf) so Jacobians/Hessians are well
+    behaved for the Gauss-Newton loop."""
+    h = x
+    for layer in params[:-1]:
+        h = jnp.tanh(h @ layer["w"] + layer["b"])
+    out = h @ params[-1]["w"] + params[-1]["b"]
+    return out
+
+
+def fit_mlp(
+    forward: Callable[[np.ndarray], np.ndarray],
+    x_samples: np.ndarray,
+    hidden: Sequence[int] = (64, 64),
+    steps: int = 2000,
+    lr: float = 1e-3,
+    seed: int = 0,
+):
+    """Train a surrogate of ``forward`` on the sampled input set.
+
+    ``forward`` maps (n, k_in) -> (n,) or (n, k_out).  Inputs/outputs are
+    standardised internally; returns a params pytree for ``mlp_apply``
+    (normalisation folded into the first/last layers so the artifact is a
+    plain MLP).
+    """
+    import optax
+
+    x = np.asarray(x_samples, np.float32)
+    y = np.asarray(forward(x), np.float32)
+    if y.ndim == 1:
+        y = y[:, None]
+    x_mu, x_sd = x.mean(0), x.std(0) + 1e-6
+    y_mu, y_sd = y.mean(0), y.std(0) + 1e-6
+    xn = jnp.asarray((x - x_mu) / x_sd)
+    yn = jnp.asarray((y - y_mu) / y_sd)
+
+    sizes = [x.shape[1], *hidden, y.shape[1]]
+    params = _init_params(jax.random.PRNGKey(seed), sizes)
+    opt = optax.adam(lr)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        def loss(p):
+            return jnp.mean((mlp_apply(p, xn) - yn) ** 2)
+
+        l, g = jax.value_and_grad(loss)(params)
+        updates, state = opt.update(g, state)
+        return optax.apply_updates(params, updates), state, l
+
+    for _ in range(steps):
+        params, state, l = step(params, state)
+
+    # Fold input standardisation into layer 0 and output de-standardisation
+    # into the last layer, so downstream use is a bare mlp_apply.
+    p0 = params[0]
+    w0 = p0["w"] / jnp.asarray(x_sd)[:, None]
+    b0 = p0["b"] - jnp.asarray(x_mu / x_sd) @ p0["w"]
+    params[0] = {"w": w0, "b": b0}
+    pl = params[-1]
+    wl = pl["w"] * jnp.asarray(y_sd)[None, :]
+    bl = pl["b"] * jnp.asarray(y_sd) + jnp.asarray(y_mu)
+    params[-1] = {"w": wl, "b": bl}
+    return params, float(l)
+
+
+class MLPOperator(ObservationModel):
+    """Observation operator whose bands are the outputs of one MLP surrogate
+    (params flow through ``aux`` as traced arrays)."""
+
+    aux_per_pixel = False
+
+    def __init__(self, n_params: int, n_bands: int, state_mapper=None):
+        self.n_params = n_params
+        self.n_bands = n_bands
+        self.mapper = None if state_mapper is None else jnp.asarray(state_mapper)
+
+    def forward_pixel(self, aux, x_pixel):
+        sub = x_pixel if self.mapper is None else x_pixel[self.mapper]
+        return mlp_apply(aux, sub)[: self.n_bands]

@@ -1,0 +1,128 @@
+"""Observation-operator protocol.
+
+The reference injects per-band factory functions producing ``(H0, sparse H)``
+pairs around a linearisation point (signature at
+``/root/reference/kafka/inference/utils.py:130-219``), with derivatives
+supplied by pickled GP emulators or hand-coded gradients
+(``sar_forward_model.py:82-98``).  Here an observation operator is a pure
+differentiable JAX function of one pixel's state; Jacobians and Hessians come
+from ``jax.jacfwd`` / ``jax.hessian``, batched over pixels with ``vmap`` —
+no hand-coded derivatives anywhere, and the whole linearisation is traced
+into the solver's XLA program.
+
+Conventions
+-----------
+- ``forward_pixel(aux, x_pixel)`` maps a ``(p,)`` state to the ``(n_bands,)``
+  predicted observations.  ``aux`` is a pytree of per-date operator data
+  (angles, emulator weights...) whose array leaves either broadcast or carry
+  a leading ``n_pix`` axis (per-pixel metadata such as SAR incidence angle).
+- Operators are registered as *stable callables*: the solver jit-caches on
+  the bound ``linearize`` method, with all per-date data flowing through
+  ``aux`` as traced arguments.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..core.types import Linearization
+
+
+def _aux_in_axes(aux: Any, n_pix: int):
+    """vmap in_axes for an aux pytree: leaves with a leading n_pix axis are
+    mapped, everything else is broadcast."""
+    return jax.tree.map(
+        lambda leaf: 0
+        if (hasattr(leaf, "ndim") and leaf.ndim > 0 and leaf.shape[0] == n_pix)
+        else None,
+        aux,
+    )
+
+
+class ObservationModel:
+    """Base class: subclasses implement ``forward_pixel``; ``forward``,
+    ``linearize`` and ``hessian`` derive from it mechanically."""
+
+    n_bands: int
+    n_params: int
+    #: Operators whose aux is shared across pixels (emulator weights etc.)
+    #: set this False to disable the leading-axis auto-detection — a weight
+    #: matrix whose first dim happens to equal n_pix must not be vmapped.
+    aux_per_pixel: bool = True
+    #: Optional (lower, upper) per-parameter physical domain; the solver
+    #: projects every Gauss-Newton iterate into it (core.solvers).
+    state_bounds = None
+
+    def forward_pixel(self, aux: Any, x_pixel: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def aux_in_axes(self, aux: Any, n_pix: int):
+        if not self.aux_per_pixel:
+            return jax.tree.map(lambda _: None, aux)
+        return _aux_in_axes(aux, n_pix)
+
+    # ---- batched derivations -------------------------------------------
+
+    def forward(self, aux: Any, x: jnp.ndarray) -> jnp.ndarray:
+        """(n_pix, p) -> (n_bands, n_pix) predicted observations."""
+        n_pix = x.shape[0]
+        h = jax.vmap(
+            self.forward_pixel, in_axes=(self.aux_in_axes(aux, n_pix), 0)
+        )(aux, x)
+        return h.T
+
+    def linearize(self, aux: Any, x: jnp.ndarray) -> Linearization:
+        """(n_pix, p) -> Linearization(h0 (B, n_pix), jac (B, n_pix, p)).
+
+        Value and Jacobian in one pass — the TPU replacement for the
+        reference's ``gp.predict`` returning ``(H_, dH_)``
+        (``inference/utils.py:87-90``).
+        """
+        n_pix = x.shape[0]
+        axes = self.aux_in_axes(aux, n_pix)
+
+        def value_and_jac(a, xi):
+            h0 = self.forward_pixel(a, xi)
+            jac = jax.jacfwd(lambda z: self.forward_pixel(a, z))(xi)
+            return h0, jac
+
+        h0, jac = jax.vmap(value_and_jac, in_axes=(axes, 0))(aux, x)
+        return Linearization(h0=h0.T, jac=jnp.transpose(jac, (1, 0, 2)))
+
+    def hessian(self, aux: Any, x: jnp.ndarray) -> jnp.ndarray:
+        """(n_pix, p) -> (n_pix, n_bands, p, p) second derivatives, the
+        equivalent of the emulators' ``gp.hessian`` (``kf_tools.py:28``)."""
+        n_pix = x.shape[0]
+        axes = self.aux_in_axes(aux, n_pix)
+        return jax.vmap(
+            lambda a, xi: jax.hessian(lambda z: self.forward_pixel(a, z))(xi),
+            in_axes=(axes, 0),
+        )(aux, x)
+
+
+class MappedStateModel(ObservationModel):
+    """Wraps a sub-state operator into the full state vector via per-band
+    index mapping — the reference's ``state_mapper``/``band_selecta`` pattern
+    (``inference/utils.py:148-153``, ``kf_tools.py:19-23``), where e.g. the
+    VIS band reads params [0, 1, 6, 2] and NIR reads [3, 4, 6, 5] of a
+    7-param state.
+
+    ``inner.forward_pixel(aux, x_sub)`` must return a scalar (one band); this
+    wrapper evaluates it once per band with that band's sub-state gather.
+    """
+
+    def __init__(self, inner, state_mappers, n_params: int):
+        self.inner = inner
+        self.mappers = jnp.asarray(state_mappers)  # (n_bands, k)
+        self.n_bands = int(self.mappers.shape[0])
+        self.n_params = n_params
+
+    def forward_pixel(self, aux: Any, x_pixel: jnp.ndarray) -> jnp.ndarray:
+        def one_band(b):
+            sub = x_pixel[self.mappers[b]]
+            return self.inner.forward_band_pixel(aux, b, sub)
+
+        return jnp.stack([one_band(b) for b in range(self.n_bands)])

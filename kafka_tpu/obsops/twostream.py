@@ -1,0 +1,116 @@
+"""Differentiable two-stream canopy albedo operator (JRC-TIP style).
+
+The reference's MODIS path inverts a two-stream radiative-transfer model
+through pickled GP emulators of the JRC "Two-stream Inversion Package"
+(state + band→parameter mapping at
+``/root/reference/kafka/inference/utils.py:148-153``; prior at
+``kf_tools.py:99-116``).  The pickles are not reproducible artifacts, so this
+module provides the physics itself: a closed-form two-stream solution for
+the bihemispherical reflectance (white-sky albedo) of a homogeneous canopy
+over a reflecting soil, written in JAX — exactly differentiable, no emulator
+required.  (A GP/MLP emulator of any forward model is still available in
+``obsops/gp.py`` / ``obsops/mlp.py`` for operators without closed forms.)
+
+State layout (the reference's 7-parameter TIP state, band mappers
+``[0, 1, 6, 2]`` / ``[3, 4, 6, 5]``):
+
+    [omega_vis, d_vis, a_soil_vis, omega_nir, d_nir, a_soil_nir, tlai]
+
+where ``omega`` is the leaf single-scattering albedo, ``d`` a diffusion /
+asymmetry factor, ``a_soil`` the background albedo, and
+``tlai = exp(-LAI / 2)`` the transformed effective LAI
+(``kf_tools.py:100-109``).
+
+Physics: classic two-flux (Kubelka-Munk / Meador-Weaver family) solution.
+With per-unit-LAI absorption ``1 - omega`` and backscatter fraction
+``b = (1 - g) / 2`` (g = asymmetry derived from ``d``):
+
+    alpha = 1 - omega * (1 - b)      # attenuation of a stream
+    beta  = omega * b                # coupling between streams
+    gamma = sqrt(alpha^2 - beta^2)
+    r_inf = (alpha - gamma) / beta   # semi-infinite canopy albedo
+
+and the finite-depth albedo over soil of albedo ``r_s`` follows from the
+two-point boundary problem solved in closed form below.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .protocol import ObservationModel
+
+_EPS = 1e-6
+
+# TIP state slots, matching the reference band_selecta (kf_tools.py:19-23).
+VIS_MAPPER = np.array([0, 1, 6, 2])
+NIR_MAPPER = np.array([3, 4, 6, 5])
+
+
+def tlai_to_lai(tlai):
+    """Invert the TIP transform TLAI = exp(-LAI/2) (kf_tools.py:100-109)."""
+    return -2.0 * jnp.log(jnp.clip(tlai, _EPS, 1.0 - _EPS))
+
+
+def twostream_albedo(omega, d, soil_albedo, lai):
+    """White-sky albedo of a homogeneous canopy over a Lambertian soil.
+
+    Closed-form two-flux solution.  ``d`` is the TIP-style diffusion /
+    asymmetry factor with 1.0 = isotropic scattering (the prior means are
+    1.0 VIS / 0.7 NIR, ``kf_tools.py:110``): it maps to an effective
+    asymmetry ``g = 1 - 1/d`` (d > 1 forward-scattering, d < 1 backward)
+    and backscatter fraction ``b = (1 - g)/2``.  Fully differentiable; all
+    inputs clamped to physical ranges so autodiff stays finite inside jit.
+    """
+    omega = jnp.clip(omega, _EPS, 1.0 - _EPS)
+    g = jnp.clip(1.0 - 1.0 / jnp.maximum(d, 0.1), -0.95, 0.95)
+    b = (1.0 - g) / 2.0
+    soil = jnp.clip(soil_albedo, 0.0, 1.0)
+    lai = jnp.maximum(lai, _EPS)
+
+    alpha = 1.0 - omega * (1.0 - b)
+    beta = omega * b
+    gamma = jnp.sqrt(jnp.maximum(alpha**2 - beta**2, _EPS**2))
+    r_inf = beta / (alpha + gamma)  # = (alpha - gamma)/beta, stable form
+
+    # Downward/upward diffuse fluxes: A(z) = c1 e^{-g z} + c2 e^{+g z},
+    # B(z) = r_inf c1 e^{-g z} + c2 / r_inf e^{+g z}; BCs A(0)=1,
+    # B(L) = soil * A(L).  Solve for c1, c2; albedo = B(0).
+    e_m = jnp.exp(-gamma * lai)
+    # growing mode expressed via e_m to avoid overflow: e_p = 1/e_m
+    # c2/c1 = e_m^2 * (r_inf - soil) / (soil - 1/r_inf)
+    ratio = e_m**2 * (r_inf - soil) / (soil - 1.0 / r_inf)
+    c1 = 1.0 / (1.0 + ratio)
+    c2 = ratio * c1
+    return r_inf * c1 + c2 / r_inf
+
+
+class TwoStreamOperator(ObservationModel):
+    """Two-band (VIS/NIR) two-stream albedo operator on the 7-param TIP
+    state — the self-contained replacement for the reference's pickled
+    per-band GP emulators in the MODIS/BHR pipeline."""
+
+    n_bands = 2
+    n_params = 7
+    # Physical domain of [omega, d, soil] x 2 + tlai: albedos/ssa in (0, 1),
+    # diffusion factor positive, transformed LAI in (0, 1).
+    state_bounds = (
+        np.array([1e-3, 0.1, 1e-3, 1e-3, 0.1, 1e-3, 5e-3], np.float32),
+        np.array([0.999, 4.0, 0.999, 0.999, 4.0, 0.999, 0.999], np.float32),
+    )
+
+    def __init__(self):
+        self._mappers = jnp.asarray(np.stack([VIS_MAPPER, NIR_MAPPER]))
+
+    def forward_band_pixel(self, aux, band: int, sub):
+        """One band from its mapped 4-vector [omega, d, tlai, a_soil]."""
+        omega, d, tlai, soil = sub[0], sub[1], sub[2], sub[3]
+        return twostream_albedo(omega, d, soil, tlai_to_lai(tlai))
+
+    def forward_pixel(self, aux, x_pixel):
+        out = []
+        for b in range(self.n_bands):
+            sub = x_pixel[self._mappers[b]]
+            out.append(self.forward_band_pixel(aux, b, sub))
+        return jnp.stack(out)

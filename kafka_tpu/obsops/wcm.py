@@ -1,0 +1,108 @@
+"""Water-Cloud Model (WCM) — the analytic SAR backscatter operator.
+
+Same physics as the reference's ``sar_observation_operator``
+(``/root/reference/kafka/observation_operators/sar_forward_model.py:13-106``):
+
+    tau        = exp(-2 B V / cos(theta))
+    sigma_veg  = A * V**E * cos(theta) * (1 - tau)
+    sigma_soil = 10 ** ((C + D * SM) / 10)
+    sigma_0    = sigma_veg + tau * sigma_soil
+
+with the published per-polarisation fits for VV/VH (``:60-61``).  The
+reference hand-codes the (LAI, SM) gradient (``:82-98``, with NaN patching);
+here the gradient and Hessian come from autodiff of this forward function.
+
+Differences from the reference, by design:
+- incidence angle ``theta`` flows in through ``aux`` per pixel/date instead
+  of the hard-coded 23 degrees (``:156``, marked TODO there);
+- negative LAI/SM cannot raise inside jit, so inputs are clamped to a small
+  positive epsilon (host-side validation available via ``validate_state``) —
+  the reference raised ValueError (``:68-71``);
+- the integer-division bug for Py3 (``:137-140``) has no equivalent here.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .protocol import ObservationModel
+
+# Published WCM fits (A, B, C, D, E) per polarisation, as in the reference.
+WCM_PARAMETERS = {
+    "VV": (0.0846, 0.0615, -14.8465, 15.907, 1.0),
+    "VH": (0.0795, 0.1464, -14.8332, 15.907, 0.0),
+}
+
+_EPS = 1e-6
+
+
+class WCMAux(NamedTuple):
+    """Per-pixel auxiliary data: incidence angle in degrees (n_pix,)."""
+
+    theta_deg: jnp.ndarray
+
+
+def wcm_sigma0(v, sm, theta_deg, coeffs):
+    """Backscatter (linear units, not dB) for vegetation descriptor ``v``
+    (e.g. LAI) and soil moisture ``sm``."""
+    a, b, c, d, e = coeffs
+    mu = jnp.cos(jnp.deg2rad(theta_deg))
+    v = jnp.maximum(v, _EPS)
+    sm = jnp.maximum(sm, _EPS)
+    tau = jnp.exp(-2.0 * b * v / mu)
+    sigma_veg = a * jnp.power(v, e) * mu * (1.0 - tau)
+    sigma_soil = 10.0 ** ((c + d * sm) / 10.0)
+    return sigma_veg + tau * sigma_soil
+
+
+class WCMOperator(ObservationModel):
+    """Dual-polarisation (VV, VH) WCM on a state whose first two parameters
+    are (vegetation descriptor, soil moisture) — the reference's state layout
+    ``(LAI1, SM1, LAI2, SM2, ...)`` (``sar_forward_model.py:128-130``)."""
+
+    def __init__(self, n_params: int = 2, v_index: int = 0, sm_index: int = 1,
+                 polarisations=("VV", "VH")):
+        self.n_params = n_params
+        if n_params == 2 and (v_index, sm_index) == (0, 1):
+            # physical domain: LAI in (0, 10], SM in (0, 0.6] m^3/m^3
+            self.state_bounds = (
+                np.array([1e-3, 1e-3], np.float32),
+                np.array([10.0, 0.6], np.float32),
+            )
+        self.v_index = v_index
+        self.sm_index = sm_index
+        self.polarisations = tuple(polarisations)
+        for pol in self.polarisations:
+            if pol not in WCM_PARAMETERS:
+                raise ValueError(
+                    "Only VV and VH polarisations available!"
+                )
+        self.n_bands = len(self.polarisations)
+        self._coeffs = np.array(
+            [WCM_PARAMETERS[p] for p in self.polarisations], np.float32
+        )
+
+    def forward_pixel(self, aux: WCMAux, x_pixel):
+        v = x_pixel[self.v_index]
+        sm = x_pixel[self.sm_index]
+        return jnp.stack(
+            [
+                wcm_sigma0(v, sm, aux.theta_deg, tuple(c))
+                for c in self._coeffs
+            ]
+        )
+
+
+def validate_state(x) -> None:
+    """Host-side input validation mirroring the reference's eager checks
+    (``sar_forward_model.py:68-71``): raises on non-positive LAI or SM."""
+    x = np.asarray(x)
+    if np.any(x[:, 0] <= 0.0):
+        raise ValueError("Negative LAI!")
+    if np.any(x[:, 1] <= 0.0):
+        raise ValueError("Negative SM!")
+    if np.any(~np.isfinite(x)):
+        raise ValueError("Non-finite state!")

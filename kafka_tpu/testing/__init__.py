@@ -1,4 +1,5 @@
-"""Test/bench support: NumPy oracles of the reference math and synthetic
-data generators."""
+"""Test/bench support: NumPy oracles of the reference math, synthetic
+observation sources, and in-memory sinks."""
 
 from . import oracle
+from .synthetic import MemoryOutput, SyntheticObservations

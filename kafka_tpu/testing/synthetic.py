@@ -1,0 +1,100 @@
+"""Synthetic observation source + in-memory output sink.
+
+The reference sketched both and finished neither: ``BHRObservationsTest``
+computes band data but returns nothing
+(``/root/reference/kafka/input_output/observations.py:313-334``) and
+``KafkaOutputMemory`` is duplicated across all three drivers
+(``kafka_test.py:135-145`` etc.).  SURVEY.md §4 calls for finishing them so
+a full ``run()`` is testable without rasters — this module does that.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.types import BandBatch
+from ..engine.protocols import DateObservation
+from ..engine.state import PixelGather
+from ..obsops.protocol import ObservationModel
+
+
+class SyntheticObservations:
+    """Generates observations by running a forward operator on a known
+    ground-truth state trajectory + noise, with random masking.
+
+    ``truth_fn(date) -> (ny, nx, p)`` raster of true states; observations
+    are ``operator.forward(aux, truth)`` + N(0, sigma^2), inverse-variance
+    ``1/sigma^2`` (the readers' convention,
+    ``Sentinel2_Observations.py:174-179``).
+    """
+
+    def __init__(
+        self,
+        dates: Sequence[datetime.datetime],
+        operator: ObservationModel,
+        truth_fn,
+        sigma: float = 0.01,
+        aux_fn=None,
+        mask_prob: float = 0.1,
+        seed: int = 0,
+    ):
+        self._dates = list(dates)
+        self.operator = operator
+        self.truth_fn = truth_fn
+        self.sigma = sigma
+        self.aux_fn = aux_fn or (lambda date, gather: None)
+        self.mask_prob = mask_prob
+        self.seed = seed
+        self.bands_per_observation = {
+            d: operator.n_bands for d in self._dates
+        }
+
+    @property
+    def dates(self):
+        return self._dates
+
+    def get_observations(self, date, gather: PixelGather) -> DateObservation:
+        truth = self.truth_fn(date)  # (ny, nx, p)
+        x_true = jnp.asarray(gather.gather(truth), jnp.float32)
+        aux = self.aux_fn(date, gather)
+        y_clean = np.asarray(self.operator.forward(aux, x_true))
+        # Per-date seeding: the same date always yields the same draw, so a
+        # resumed run sees identical observations to the original.
+        rng = np.random.default_rng((self.seed, date.toordinal()))
+        noise = rng.normal(0.0, self.sigma, y_clean.shape)
+        y = (y_clean + noise).astype(np.float32)
+        mask = rng.uniform(size=y.shape) > self.mask_prob
+        mask &= gather.valid[None, :]
+        r_inv = np.where(mask, 1.0 / self.sigma**2, 0.0).astype(np.float32)
+        bands = BandBatch(
+            y=jnp.asarray(np.where(mask, y, 0.0)),
+            r_inv=jnp.asarray(r_inv),
+            mask=jnp.asarray(mask),
+        )
+        return DateObservation(bands=bands, operator=self.operator, aux=aux)
+
+
+class MemoryOutput:
+    """In-memory output sink (the finished ``KafkaOutputMemory``): stores
+    per-parameter mean and sigma rasters keyed by timestep."""
+
+    def __init__(self):
+        self.output: Dict[datetime.datetime, Dict[str, np.ndarray]] = {}
+
+    def dump_data(self, timestep, x, p_inv_diag, gather: PixelGather,
+                  parameter_list) -> None:
+        sol = {}
+        for ii, param in enumerate(parameter_list):
+            sol[param] = gather.scatter(np.asarray(x)[:, ii])
+            if p_inv_diag is not None:
+                sigma = 1.0 / np.sqrt(
+                    np.maximum(np.asarray(p_inv_diag)[:, ii], 1e-30)
+                )
+                sol[param + "_unc"] = gather.scatter(
+                    sigma.astype(np.float32)
+                )
+        self.output[timestep] = sol

@@ -18,6 +18,15 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# Force the host platform even though the TPU plugin's sitecustomize pins
+# itself as default: tests must neither compile on the real chip nor hang
+# when the TPU tunnel is unhealthy.  This must run before any backend
+# initialisation (first jax.devices()/computation), hence here in conftest.
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
 
 def cpu_devices():
     return jax.devices("cpu")

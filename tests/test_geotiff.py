@@ -1,0 +1,169 @@
+"""GeoTIFF codec tests: round-trips, compression paths, geo tags, the
+native C++ codec, and the output writer's reference-naming contract."""
+
+import datetime
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from kafka_tpu.engine.state import make_pixel_gather
+from kafka_tpu.io import (
+    Chunk,
+    GeoInfo,
+    GeoTIFFOutput,
+    chunk_geotransform,
+    chunk_mask,
+    get_chunks,
+    read_geotiff,
+    write_geotiff,
+)
+
+RNG = np.random.default_rng(21)
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("dtype", [np.float32, np.uint16, np.uint8,
+                                       np.int32])
+    def test_roundtrip_dtypes(self, tmp_path, dtype):
+        if np.issubdtype(dtype, np.floating):
+            arr = RNG.normal(size=(70, 53)).astype(dtype)
+        else:
+            arr = RNG.integers(0, 200, size=(70, 53)).astype(dtype)
+        path = str(tmp_path / "t.tif")
+        write_geotiff(path, arr)
+        back, info = read_geotiff(path)
+        np.testing.assert_array_equal(back, arr)
+        assert info.dtype == np.dtype(dtype)
+
+    def test_roundtrip_uncompressed_and_predictor(self, tmp_path):
+        arr = RNG.integers(0, 1000, size=(40, 40)).astype(np.uint16)
+        p1 = str(tmp_path / "u.tif")
+        write_geotiff(p1, arr, compress=False)
+        back, info = read_geotiff(p1)
+        np.testing.assert_array_equal(back, arr)
+        assert info.compression == 1
+        p2 = str(tmp_path / "p.tif")
+        write_geotiff(p2, arr, predictor=2)
+        back2, info2 = read_geotiff(p2)
+        np.testing.assert_array_equal(back2, arr)
+        assert info2.predictor == 2
+
+    def test_roundtrip_multiband(self, tmp_path):
+        arr = RNG.normal(size=(33, 45, 3)).astype(np.float32)
+        path = str(tmp_path / "mb.tif")
+        write_geotiff(path, arr)
+        back, info = read_geotiff(path)
+        assert info.n_bands == 3
+        np.testing.assert_array_equal(back, arr)
+
+    def test_roundtrip_non_tile_aligned(self, tmp_path):
+        arr = RNG.normal(size=(300, 513)).astype(np.float32)
+        path = str(tmp_path / "big.tif")
+        write_geotiff(path, arr, tile_size=256)
+        back, _ = read_geotiff(path)
+        np.testing.assert_array_equal(back, arr)
+
+    def test_geo_tags_roundtrip(self, tmp_path):
+        arr = np.zeros((16, 16), np.float32)
+        gt = (499980.0, 10.0, 0.0, 4400040.0, 0.0, -10.0)
+        geo = GeoInfo(geotransform=gt, projection="WGS 84 / UTM zone 30N",
+                      epsg=32630, nodata=-999.0)
+        path = str(tmp_path / "geo.tif")
+        write_geotiff(path, arr, geo)
+        _, info = read_geotiff(path)
+        np.testing.assert_allclose(info.geo.geotransform, gt)
+        assert info.geo.epsg == 32630
+        assert "UTM zone 30N" in info.geo.projection
+        assert info.geo.nodata == -999.0
+
+    def test_bigtiff_roundtrip(self, tmp_path):
+        arr = RNG.normal(size=(64, 64)).astype(np.float32)
+        path = str(tmp_path / "big8.tif")
+        write_geotiff(path, arr, bigtiff=True)
+        with open(path, "rb") as f:
+            assert f.read(4)[2:4] == b"+\x00"  # magic 43
+        back, _ = read_geotiff(path)
+        np.testing.assert_array_equal(back, arr)
+
+
+class TestNativeCodec:
+    def test_native_matches_zlib(self):
+        from kafka_tpu.native import load_library
+
+        lib = load_library()
+        if lib is None:
+            pytest.skip("native codec not built")
+        blobs = [RNG.integers(0, 255, size=1000).astype(np.uint8).tobytes()
+                 for _ in range(20)]
+        comp = lib.deflate_many(blobs, 6)
+        for c, b in zip(comp, blobs):
+            assert zlib.decompress(c) == b
+        decomp = lib.inflate_many([zlib.compress(b) for b in blobs], 1000)
+        assert decomp == blobs
+
+
+class TestOutputWriter:
+    def test_reference_naming_and_content(self, tmp_path):
+        mask = np.zeros((20, 20), bool)
+        mask[5:15, 5:15] = True
+        gather = make_pixel_gather(mask, pad_multiple=128)
+        x = RNG.normal(size=(gather.n_pad, 2)).astype(np.float32)
+        p_inv_diag = np.full((gather.n_pad, 2), 16.0, np.float32)
+        out = GeoTIFFOutput(
+            ["lai", "sm"], (0, 10, 0, 0, 0, -10), folder=str(tmp_path),
+            prefix="0xa",
+        )
+        ts = datetime.datetime(2017, 7, 9)
+        out.dump_data(ts, x, p_inv_diag, gather, ["lai", "sm"])
+        # Reference naming: {param}_{A%Y%j}_{prefix}[_unc].tif
+        # (observations.py:358-365)
+        for param in ("lai", "sm"):
+            mean_f = tmp_path / f"{param}_A2017190_0xa.tif"
+            unc_f = tmp_path / f"{param}_A2017190_0xa_unc.tif"
+            assert mean_f.exists() and unc_f.exists()
+        lai, _ = read_geotiff(str(tmp_path / "lai_A2017190_0xa.tif"))
+        assert lai.shape == mask.shape
+        np.testing.assert_allclose(
+            lai[mask], x[: gather.n_valid, 0], rtol=1e-6
+        )
+        assert np.all(lai[~mask] == 0)
+        unc, _ = read_geotiff(str(tmp_path / "lai_A2017190_0xa_unc.tif"))
+        np.testing.assert_allclose(unc[mask], 0.25, rtol=1e-6)
+
+    def test_async_writer_flush(self, tmp_path):
+        mask = np.ones((8, 8), bool)
+        gather = make_pixel_gather(mask, pad_multiple=64)
+        out = GeoTIFFOutput(
+            ["a"], (0, 1, 0, 0, 0, -1), folder=str(tmp_path),
+            async_writes=True,
+        )
+        for i in range(3):
+            out.dump_data(
+                datetime.datetime(2020, 1, 1 + i),
+                np.full((gather.n_pad, 1), float(i), np.float32),
+                None, gather, ["a"],
+            )
+        out.close()
+        assert len(list(tmp_path.glob("*.tif"))) == 3
+
+
+class TestChunks:
+    def test_get_chunks_matches_reference_semantics(self):
+        chunks = list(get_chunks(300, 200, (128, 128)))
+        # column-major: X outer, Y inner (input_output/utils.py:20-40)
+        assert [c.chunk_no for c in chunks] == [1, 2, 3, 4, 5, 6]
+        assert chunks[0] == Chunk(0, 0, 128, 128, 1)
+        assert chunks[1] == Chunk(0, 128, 128, 72, 2)
+        assert chunks[-1] == Chunk(256, 128, 44, 72, 6)
+
+    def test_chunk_mask_and_geotransform(self):
+        mask = np.zeros((200, 300), bool)
+        mask[130:150, 260:280] = True
+        c = list(get_chunks(300, 200, (128, 128)))[-1]
+        sub = chunk_mask(mask, c)
+        assert sub.shape == (72, 44)
+        assert sub.sum() == mask.sum()
+        gt = chunk_geotransform((1000.0, 10, 0, 2000.0, 0, -10), c)
+        assert gt == (1000.0 + 256 * 10, 10, 0, 2000.0 - 128 * 10, 0, -10)
