@@ -1,0 +1,103 @@
+"""Benchmark: assimilation throughput (pixels/sec) vs the CPU reference path.
+
+The reference publishes no numbers (SURVEY.md §6), so the baseline is
+*measured*: the NumPy/SciPy-sparse oracle of its solver path
+(``kafka_tpu.testing.oracle`` — sparse block-diagonal normal equations +
+SuperLU, the exact algorithm of
+``/root/reference/kafka/inference/solvers.py:100-145`` with the
+``linear_kf.py:245-307`` Gauss-Newton loop) on this host's CPU, on the
+reference's own chunk size (16384 pixels = one 128x128 chunk,
+``kafka_test_S2.py:202``).  Ours is the identical problem solved by the
+jitted batched-dense TPU path.
+
+Prints ONE JSON line:
+    {"metric": "assimilation_throughput", "value": <device px/s>,
+     "unit": "pixels/sec", "vs_baseline": <speedup over SciPy CPU>}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bench_device(n_pix: int, reps: int = 5) -> float:
+    """Jitted batched-dense iterated solve on the default JAX device."""
+    import jax
+    import jax.numpy as jnp
+
+    from kafka_tpu.core.solvers import assimilate_date_jit
+    from kafka_tpu.testing.synthetic import make_tip_problem
+
+    op, bands, x0, p_inv0 = make_tip_problem(n_pix)
+    opts = {"state_bounds": (
+        jnp.asarray(op.state_bounds[0]), jnp.asarray(op.state_bounds[1])
+    )}
+    args = (op.linearize, bands, x0, p_inv0, None, opts)
+    # Warm-up compiles; measured reps reuse the executable.
+    x, p_inv, diags = assimilate_date_jit(*args)
+    x.block_until_ready()
+    n_iters = int(diags.n_iterations)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        x, p_inv, _ = assimilate_date_jit(*args)
+    x.block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+    print(
+        f"device: {n_pix} px, {n_iters} GN iters, {dt*1e3:.1f} ms/solve "
+        f"on {jax.devices()[0].platform}",
+        file=sys.stderr,
+    )
+    return n_pix / dt
+
+
+def bench_oracle(n_pix: int, reps: int = 1) -> float:
+    """The reference algorithm (sparse block-diag + SuperLU) on host CPU."""
+    import jax.numpy as jnp
+
+    from kafka_tpu.testing.oracle import iterated_sparse_solve
+    from kafka_tpu.testing.synthetic import make_tip_problem
+
+    op, bands, x0, p_inv0 = make_tip_problem(n_pix)
+    y_b = list(np.asarray(bands.y))
+    r_b = list(np.asarray(bands.r_inv))
+    m_b = list(np.asarray(bands.mask))
+
+    def linearize(x):
+        lin = op.linearize(None, jnp.asarray(x, jnp.float32))
+        return list(np.asarray(lin.h0)), list(np.asarray(lin.jac))
+
+    x0_np = np.asarray(x0)
+    p_inv_np = np.asarray(p_inv0)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        _, _, n_iters = iterated_sparse_solve(
+            linearize, y_b, r_b, m_b, x0_np, p_inv_np
+        )
+    dt = (time.perf_counter() - t0) / reps
+    print(
+        f"oracle: {n_pix} px, {n_iters} GN iters, {dt*1e3:.1f} ms/solve "
+        f"(SciPy SuperLU)",
+        file=sys.stderr,
+    )
+    return n_pix / dt
+
+
+def main():
+    # Baseline on the reference's chunk size; device on a full-tile-scale
+    # batch (batched-dense path is chunk-size-agnostic).
+    base_px_s = bench_oracle(16384)
+    dev_px_s = bench_device(1 << 19)
+    print(json.dumps({
+        "metric": "assimilation_throughput",
+        "value": round(dev_px_s, 1),
+        "unit": "pixels/sec",
+        "vs_baseline": round(dev_px_s / base_px_s, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

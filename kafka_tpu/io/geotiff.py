@@ -400,6 +400,13 @@ def write_geotiff(
         arr = arr.astype(np.float32)
         dtype = arr.dtype
     bits, fmt = _DTYPE_TO_TAGS[dtype]
+    if predictor == 2 and dtype.kind == "f":
+        # TIFF predictor 2 is integer-only (floats use predictor 3); a
+        # float-diff file would be unreadable by libtiff/GDAL.
+        raise ValueError(
+            "predictor=2 requires an integer dtype; floats must use "
+            "predictor 1 (got %s)" % dtype
+        )
 
     th = tw = tile_size
     tiles_down = (h + th - 1) // th

@@ -91,29 +91,5 @@ int rk_deflate_batch(int64_t n, const uint8_t** in_ptrs,
   return status.load();
 }
 
-// Horizontal-differencing predictor (TIFF predictor=2) over a batch of
-// decoded tiles, in place.  elem_size in {1,2,4}; each tile is
-// rows x cols x bands elements.
-int rk_unpredict_batch(int64_t n, uint8_t** tiles, int64_t rows,
-                       int64_t cols, int64_t bands, int64_t elem_size,
-                       int n_threads) {
-  parallel_for(n, n_threads, [&](int64_t i) {
-    uint8_t* t = tiles[i];
-    int64_t row_elems = cols * bands;
-    for (int64_t r = 0; r < rows; ++r) {
-      if (elem_size == 1) {
-        uint8_t* p = t + r * row_elems;
-        for (int64_t c = bands; c < row_elems; ++c) p[c] += p[c - bands];
-      } else if (elem_size == 2) {
-        uint16_t* p = reinterpret_cast<uint16_t*>(t) + r * row_elems;
-        for (int64_t c = bands; c < row_elems; ++c) p[c] += p[c - bands];
-      } else if (elem_size == 4) {
-        uint32_t* p = reinterpret_cast<uint32_t*>(t) + r * row_elems;
-        for (int64_t c = bands; c < row_elems; ++c) p[c] += p[c - bands];
-      }
-    }
-  });
-  return 0;
-}
 
 }  // extern "C"

@@ -1,0 +1,45 @@
+"""Device-mesh sharding + multi-host tile scheduling.
+
+The TPU-native replacement for the reference's two distribution layers:
+pixels within a chunk shard over the device mesh via GSPMD (``mesh``,
+``step``), whole chunks/tiles distribute across hosts via a deterministic
+work queue (``scheduler`` — the dask-equivalent of
+``kafka_test_Py36.py:242-255``).
+"""
+
+from .mesh import (
+    PIXEL_AXIS,
+    initialize_distributed,
+    make_pixel_mesh,
+    pad_for_mesh,
+    pixel_sharding,
+    replicated,
+    shard_bands,
+    shard_state,
+)
+from .scheduler import (
+    ChunkAssignment,
+    assign_chunks,
+    mark_done,
+    pending_chunks,
+    run_chunks,
+)
+from .step import make_sharded_forward, make_sharded_step
+
+__all__ = [
+    "PIXEL_AXIS",
+    "initialize_distributed",
+    "make_pixel_mesh",
+    "pad_for_mesh",
+    "pixel_sharding",
+    "replicated",
+    "shard_bands",
+    "shard_state",
+    "ChunkAssignment",
+    "assign_chunks",
+    "mark_done",
+    "pending_chunks",
+    "run_chunks",
+    "make_sharded_forward",
+    "make_sharded_step",
+]

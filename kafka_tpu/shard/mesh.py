@@ -1,0 +1,95 @@
+"""Device mesh + pixel-axis sharding.
+
+The reference's only intra-node parallel axis is the pixel batch: every
+pixel's update is independent (SURVEY.md §2.3; proof that A is per-pixel
+block-diagonal at ``/root/reference/kafka/inference/utils.py:193-215``).
+The TPU mapping is therefore a 1-D device mesh with the pixel axis
+partitioned across it — GSPMD splits every batched kernel with ZERO
+collectives in the hot path (nothing couples across pixels; the only
+reductions are the scalar convergence norm and diagnostics, which XLA
+lowers to a cheap ``psum`` over ICI).
+
+Multi-host: the same mesh spans hosts via ``jax.distributed.initialize``;
+pixel shards ride ICI within a pod slice while whole tiles are distributed
+across hosts by the scheduler (``shard.scheduler``) — the dask-equivalent
+of ``kafka_test_Py36.py:242-255``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PIXEL_AXIS = "pixels"
+
+
+def make_pixel_mesh(devices: Optional[Sequence[Any]] = None) -> Mesh:
+    """1-D mesh over all (or the given) devices, axis name ``pixels``."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (PIXEL_AXIS,))
+
+
+def pixel_sharding(mesh: Mesh, batch_axis: int = 0,
+                   ndim: int = 2) -> NamedSharding:
+    """NamedSharding partitioning axis ``batch_axis`` of an ``ndim``-array
+    over the pixel mesh axis; all other axes replicated.
+
+    State arrays are pixel-leading (``(n_pix, p)``, ``(n_pix, p, p)``:
+    ``batch_axis=0``); band batches are band-leading (``(n_bands, n_pix)``:
+    ``batch_axis=1``).
+    """
+    spec = [None] * ndim
+    spec[batch_axis] = PIXEL_AXIS
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_state(mesh: Mesh, x, p_inv=None):
+    """Device-put state arrays with the pixel axis partitioned."""
+    x = jax.device_put(x, pixel_sharding(mesh, 0, np.ndim(x)))
+    if p_inv is not None:
+        p_inv = jax.device_put(p_inv, pixel_sharding(mesh, 0, np.ndim(p_inv)))
+    return x, p_inv
+
+
+def shard_bands(mesh: Mesh, bands):
+    """Device-put a ``BandBatch`` (all fields ``(n_bands, n_pix)``) with the
+    pixel axis partitioned."""
+    sh = pixel_sharding(mesh, 1, 2)
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), bands)
+
+
+def pad_for_mesh(n: int, mesh: Mesh, lane: int = 128) -> int:
+    """Smallest padded pixel count >= n that is divisible by the mesh size
+    and keeps every shard lane-aligned (multiples of 128 for the TPU VPU
+    lane dimension)."""
+    n_dev = mesh.devices.size
+    quantum = n_dev * lane
+    return max(int(np.ceil(max(n, 1) / quantum)) * quantum, quantum)
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up: ``jax.distributed.initialize`` (the replacement
+    for the reference's dask ``Client('tcp://...')`` handshake,
+    ``kafka_test_Py36.py:249``).
+
+    With no arguments this defers to JAX's own pod auto-detection (the
+    no-arg ``jax.distributed.initialize()`` contract); explicitly passing
+    ``num_processes=1`` skips initialization for single-process runs.
+    """
+    if num_processes is not None and num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

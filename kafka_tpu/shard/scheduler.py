@@ -1,0 +1,98 @@
+"""Multi-host tile scheduler — the dask-equivalent.
+
+The reference farms independent 256x256 spatial chunks over a
+dask.distributed cluster (``/root/reference/kafka_test_Py36.py:242-255``)
+with fault tolerance delegated to dask and results written as per-chunk
+prefixed GeoTIFFs (``:164-166``) so reruns are cheap.  The TPU-native
+replacement:
+
+- **within a host/slice**: chunks are just more pixels — the pixel mesh
+  absorbs them (no scheduler needed);
+- **across hosts**: a deterministic round-robin assignment of chunks by
+  ``jax.process_index()`` (every process computes the same assignment, no
+  coordinator, no message passing — the "zero collectives" structure of the
+  problem extends to scheduling);
+- **restartability**: a per-chunk ``.done`` marker next to the outputs.
+  ``pending_chunks`` skips completed work, so a restarted job (or a
+  replacement host) re-runs only what's missing — strictly better than the
+  reference, which reruns every chunk the dead worker owned.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Callable, Iterable, List, Optional, Sequence
+
+import jax
+
+from ..io.tiling import Chunk
+
+
+@dataclass(frozen=True)
+class ChunkAssignment:
+    chunk: Chunk
+    owner: int           # process index that runs it
+    prefix: str          # output filename prefix (chunk-id trick,
+    #                      kafka_test_Py36.py:164-166)
+
+
+def assign_chunks(chunks: Sequence[Chunk],
+                  num_processes: Optional[int] = None,
+                  ) -> List[ChunkAssignment]:
+    """Deterministic round-robin over hosts; identical on every process."""
+    n = num_processes if num_processes is not None else jax.process_count()
+    return [
+        ChunkAssignment(chunk=c, owner=i % n, prefix=f"{c.chunk_no:04x}")
+        for i, c in enumerate(chunks)
+    ]
+
+
+def marker_path(outdir: str, prefix: str) -> str:
+    return os.path.join(outdir, f".chunk_{prefix}.done")
+
+
+def mark_done(outdir: str, prefix: str, payload: Optional[dict] = None) -> None:
+    with open(marker_path(outdir, prefix), "w") as f:
+        json.dump({"finished": time.time(), **(payload or {})}, f)
+
+
+def pending_chunks(assignments: Iterable[ChunkAssignment], outdir: str,
+                   process_index: Optional[int] = None,
+                   ) -> List[ChunkAssignment]:
+    """This process's still-to-run chunks (restart-safe)."""
+    me = process_index if process_index is not None else jax.process_index()
+    return [
+        a for a in assignments
+        if a.owner == me and not os.path.exists(marker_path(outdir, a.prefix))
+    ]
+
+
+def run_chunks(
+    chunks: Sequence[Chunk],
+    run_one: Callable[[Chunk, str], None],
+    outdir: str,
+    num_processes: Optional[int] = None,
+    process_index: Optional[int] = None,
+) -> dict:
+    """Execute ``run_one(chunk, prefix)`` for every pending chunk owned by
+    this process.  The serial-loop / ``client.map`` duality of the reference
+    (``kafka_test_S2.py:203-205`` vs ``kafka_test_Py36.py:254``) collapses
+    into this one function: single-process runs own every chunk."""
+    os.makedirs(outdir, exist_ok=True)
+    assignments = assign_chunks(chunks, num_processes)
+    todo = pending_chunks(assignments, outdir, process_index)
+    stats = {"assigned": len([a for a in assignments if a.owner ==
+                              (process_index if process_index is not None
+                               else jax.process_index())]),
+             "run": 0, "skipped": 0, "wall_s": 0.0}
+    stats["skipped"] = stats["assigned"] - len(todo)
+    t0 = time.time()
+    for a in todo:
+        run_one(a.chunk, a.prefix)
+        mark_done(outdir, a.prefix, {"chunk": a.chunk.chunk_no})
+        stats["run"] += 1
+    stats["wall_s"] = time.time() - t0
+    return stats

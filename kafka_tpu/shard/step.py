@@ -1,0 +1,107 @@
+"""The fully-sharded assimilation step: one XLA program per date.
+
+Fuses the whole per-timestep pipeline — state propagation
+(``kf_tools.py:136-353`` semantics), prior blending, and the multi-band
+Gauss-Newton solve (``linear_kf.py:245-307``) — into ONE jitted program
+partitioned over the pixel mesh axis.  GSPMD splits every batched kernel
+across devices; because pixels never couple (SURVEY.md §2.3), the program
+contains no collectives except the scalar convergence-norm ``psum`` inside
+the while-loop, which rides ICI.
+
+This is the multi-chip execution path: build the step once per operator
+configuration, then feed it each date's band batch.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..core import propagators as prop
+from ..core.solvers import LinearizeFn, iterated_solve
+from ..core.types import BandBatch, SolveDiagnostics
+from .mesh import pixel_sharding, replicated
+
+
+def make_sharded_step(
+    linearize: LinearizeFn,
+    mesh: Mesh,
+    state_propagator: Optional[Callable] = None,
+    use_prior: bool = True,
+    solver_options: Optional[dict] = None,
+):
+    """Build the jitted, mesh-partitioned per-date step.
+
+    Returned callable signature::
+
+        step(bands, x_analysis, p_inv_analysis, m_matrix, q_diag,
+             prior_mean, prior_inv, operator_params)
+            -> (x_analysis, p_inv_analysis, diagnostics)
+
+    ``prior_mean`` / ``prior_inv`` are ignored (pass anything) when
+    ``use_prior=False``.  ``operator_params`` carries per-date operator data
+    (angles, emulator weights) as a traced pytree.
+    """
+    opts = dict(solver_options or {})
+
+    def _step(bands: BandBatch, x_analysis, p_inv_analysis, m_matrix,
+              q_diag, prior_mean, prior_inv, operator_params):
+        # --- advance (propagate_and_blend_prior, kf_tools.py:136-171) ---
+        pm = prior_mean if use_prior else None
+        pi = prior_inv if use_prior else None
+        x_f, p_f, p_f_inv = prop.advance(
+            x_analysis, None, p_inv_analysis, m_matrix, q_diag,
+            prior_mean=pm, prior_cov_inverse=pi,
+            state_propagator=state_propagator,
+        )
+        if x_f is None:  # no propagator, no prior: persistence forecast
+            x_f, p_f_inv = x_analysis, p_inv_analysis
+        elif p_f_inv is None:
+            from ..core.linalg import spd_inverse_batched
+            p_f_inv = spd_inverse_batched(p_f)
+        # --- the multi-band Gauss-Newton solve -------------------------
+        x_a, p_inv_a, diags = iterated_solve(
+            linearize, bands, x_f, p_f_inv, operator_params, **opts
+        )
+        return x_a, p_inv_a, diags
+
+    px1 = pixel_sharding(mesh, 0, 2)     # (n_pix, p)
+    px2 = pixel_sharding(mesh, 0, 3)     # (n_pix, p, p)
+    bnd = pixel_sharding(mesh, 1, 2)     # (n_bands, n_pix)
+    rep = replicated(mesh)
+    band_sh = BandBatch(y=bnd, r_inv=bnd, mask=bnd)
+
+    return jax.jit(
+        _step,
+        in_shardings=(band_sh, px1, px2, rep, rep, px1, px2, None),
+        # Diagnostics: innovations/fwd are band-major pixel arrays, the two
+        # loop scalars are replicated.
+        out_shardings=(
+            px1, px2,
+            SolveDiagnostics(
+                innovations=bnd, fwd_modelled=bnd,
+                n_iterations=rep, convergence_norm=rep,
+            ),
+        ),
+    )
+
+
+def make_sharded_forward(forward: Callable, mesh: Mesh):
+    """Jit a plain batched forward model (``(aux, (n_pix, p)) -> (n_bands,
+    n_pix)``) over the pixel mesh — the sharded inference/prediction path."""
+    px1 = pixel_sharding(mesh, 0, 2)
+    bnd = pixel_sharding(mesh, 1, 2)
+
+    return jax.jit(
+        functools.partial(_forward_apply, forward),
+        in_shardings=(None, px1),
+        out_shardings=bnd,
+    )
+
+
+def _forward_apply(forward, aux, x):
+    return forward(aux, x)

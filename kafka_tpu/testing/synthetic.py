@@ -78,6 +78,37 @@ class SyntheticObservations:
         return DateObservation(bands=bands, operator=self.operator, aux=aux)
 
 
+def make_tip_problem(n_pix: int, seed: int = 0, sigma: float = 0.005,
+                     mask_prob: float = 0.1):
+    """Standard synthetic TIP/two-stream assimilation problem used by the
+    sharding tests, ``bench.py`` and ``__graft_entry__.py``: truth drawn
+    around the TIP prior, two-stream forward + noise, random masking.
+
+    Returns ``(operator, bands, x0, p_inv0)`` with ``x0``/``p_inv0`` the
+    broadcast TIP prior (the forecast for a first-timestep assimilation).
+    """
+    from ..core.propagators import broadcast_prior, tip_prior
+    from ..obsops.twostream import TwoStreamOperator
+
+    op = TwoStreamOperator()
+    rng = np.random.default_rng(seed)
+    x0, p_inv0 = broadcast_prior(tip_prior(), n_pix)
+    truth = np.clip(
+        np.asarray(x0) + rng.normal(0, 0.05, (n_pix, op.n_params)),
+        0.05, 0.95,
+    ).astype(np.float32)
+    y = np.array(op.forward(None, jnp.asarray(truth)))
+    y += rng.normal(0, sigma, y.shape)
+    mask = rng.uniform(size=y.shape) > mask_prob
+    r_inv = np.where(mask, 1.0 / sigma**2, 0.0).astype(np.float32)
+    bands = BandBatch(
+        y=jnp.asarray(np.where(mask, y, 0.0).astype(np.float32)),
+        r_inv=jnp.asarray(r_inv),
+        mask=jnp.asarray(mask),
+    )
+    return op, bands, x0, p_inv0
+
+
 class MemoryOutput:
     """In-memory output sink (the finished ``KafkaOutputMemory``): stores
     per-parameter mean and sigma rasters keyed by timestep."""

@@ -49,6 +49,10 @@ class TestRoundTrip:
         back2, info2 = read_geotiff(p2)
         np.testing.assert_array_equal(back2, arr)
         assert info2.predictor == 2
+        # predictor 2 is integer-only per the TIFF spec
+        with pytest.raises(ValueError):
+            write_geotiff(str(tmp_path / "f.tif"),
+                          arr.astype(np.float32), predictor=2)
 
     def test_roundtrip_multiband(self, tmp_path):
         arr = RNG.normal(size=(33, 45, 3)).astype(np.float32)

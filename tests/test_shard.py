@@ -1,0 +1,107 @@
+"""Sharding tests on the virtual 8-device CPU mesh (SURVEY.md §4: the
+multi-device test the reference entirely lacks)."""
+
+import datetime
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kafka_tpu.core.propagators import propagate_information_filter
+from kafka_tpu.core.solvers import iterated_solve
+from kafka_tpu.io.tiling import get_chunks
+from kafka_tpu.testing.synthetic import make_tip_problem
+from kafka_tpu.shard import (
+    assign_chunks,
+    make_pixel_mesh,
+    make_sharded_step,
+    pad_for_mesh,
+    pending_chunks,
+    run_chunks,
+    shard_bands,
+    shard_state,
+)
+
+
+_problem = make_tip_problem
+
+
+def test_sharded_step_matches_single_device(eight_cpu_devices):
+    """The fully-sharded advance+solve must agree with the unsharded path."""
+    mesh = make_pixel_mesh(eight_cpu_devices)
+    n_pix = pad_for_mesh(300, mesh, lane=8)
+    assert n_pix % 8 == 0
+    op, bands, x0, p_inv0 = _problem(n_pix)
+    m = jnp.eye(7, dtype=jnp.float32)
+    q = jnp.full((7,), 0.01, jnp.float32)
+    opts = {"state_bounds": (
+        jnp.asarray(op.state_bounds[0]), jnp.asarray(op.state_bounds[1])
+    )}
+
+    step = make_sharded_step(
+        op.linearize, mesh,
+        state_propagator=propagate_information_filter,
+        use_prior=False, solver_options=opts,
+    )
+    xs, ps = shard_state(mesh, x0, p_inv0)
+    bs = shard_bands(mesh, bands)
+    x_sh, p_inv_sh, diags_sh = step(bs, xs, ps, m, q, xs, ps, None)
+
+    # Unsharded reference path: same propagator + solve on one device.
+    x_f, _, p_f_inv = propagate_information_filter(x0, None, p_inv0, m, q)
+    x_ref, p_inv_ref, diags_ref = iterated_solve(
+        op.linearize, bands, x_f, p_f_inv, None, **opts
+    )
+    np.testing.assert_allclose(
+        np.asarray(x_sh), np.asarray(x_ref), atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(p_inv_sh), np.asarray(p_inv_ref), rtol=2e-4, atol=2e-2
+    )
+    assert int(diags_sh[2]) == int(diags_ref.n_iterations)
+
+
+def test_sharded_step_is_actually_partitioned(eight_cpu_devices):
+    mesh = make_pixel_mesh(eight_cpu_devices)
+    n_pix = pad_for_mesh(100, mesh, lane=8)
+    op, bands, x0, p_inv0 = _problem(n_pix)
+    xs, ps = shard_state(mesh, x0, p_inv0)
+    # Each device holds 1/8 of the pixel axis.
+    assert len(xs.sharding.device_set) == 8
+    shard_rows = {s.data.shape[0] for s in xs.addressable_shards}
+    assert shard_rows == {n_pix // 8}
+
+
+def test_pad_for_mesh(eight_cpu_devices):
+    mesh = make_pixel_mesh(eight_cpu_devices)
+    n = pad_for_mesh(1000, mesh)
+    assert n >= 1000 and n % (8 * 128) == 0
+    assert pad_for_mesh(1, mesh) == 8 * 128
+
+
+def test_scheduler_round_robin_and_restart(tmp_path):
+    chunks = list(get_chunks(512, 512, (128, 128)))  # 16 chunks
+    a = assign_chunks(chunks, num_processes=4)
+    owners = [x.owner for x in a]
+    assert owners == [i % 4 for i in range(16)]
+    # All processes together cover every chunk exactly once.
+    outdir = str(tmp_path)
+    ran = []
+
+    def run_one(chunk, prefix):
+        ran.append((chunk.chunk_no, prefix))
+
+    for p in range(4):
+        stats = run_chunks(chunks, run_one, outdir,
+                           num_processes=4, process_index=p)
+        assert stats["run"] == 4 and stats["skipped"] == 0
+    assert len(ran) == 16
+    assert len({c for c, _ in ran}) == 16
+    # Restart: everything already marked done -> nothing reruns.
+    stats = run_chunks(chunks, run_one, outdir,
+                       num_processes=4, process_index=0)
+    assert stats["run"] == 0 and stats["skipped"] == 4
+    assert len(ran) == 16
+    assert pending_chunks(assign_chunks(chunks, 4), outdir, 2) == []
