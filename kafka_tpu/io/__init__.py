@@ -1,5 +1,23 @@
-"""Host-side raster I/O: GeoTIFF codec, output writers, chunk tiling."""
+"""Host-side raster I/O: GeoTIFF codec, warping, sensor readers, output
+writers, chunk tiling."""
 
 from .geotiff import GeoInfo, TiffInfo, read_geotiff, read_info, write_geotiff
+from .modis import BHRObservations
 from .output import GeoTIFFOutput
+from .sentinel1 import S1Observations
+from .sentinel2 import (
+    Sentinel2Observations,
+    find_nearest_geometry,
+    geometry_bank_aux_builder,
+    parse_s2_xml,
+)
 from .tiling import Chunk, chunk_geotransform, chunk_mask, get_chunks
+from .warp import (
+    from_lonlat,
+    grid_mapping,
+    lonlat_to_utm,
+    reproject_raster,
+    resample,
+    to_lonlat,
+    utm_to_lonlat,
+)

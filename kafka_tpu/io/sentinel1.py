@@ -1,0 +1,167 @@
+"""Sentinel-1 SAR backscatter reader (NetCDF4/HDF5 via h5py).
+
+Reproduces the observation semantics of the reference's ``S1Observations``
+(``/root/reference/kafka/input_output/Sentinel1_Observations.py:56-197``):
+
+- ``*.nc`` discovery with the acquisition datetime parsed from filename
+  field 5 (``S1?_.._.._YYYYMMDDTHHMMSS_...``) (``:67-80``);
+- two bands: VV then VH, read from the ``sigma0_VV``/``sigma0_VH``
+  variables (``:172-179``);
+- -999 treated as missing (``:24,134-152``);
+- 5% relative uncertainty placeholder (ENL refinement is the reference's
+  open TODO, ``:106-132``) stored as inverse variance (``:182-188``);
+- the per-pixel incidence angle ``theta`` warped to the state grid and
+  carried to the operator (``:191-195`` — there a TODO, here implemented:
+  the WCM aux takes the real angle raster instead of the hard-coded 23
+  degrees of ``sar_forward_model.py:156``).
+
+The reference reads these files through GDAL's NetCDF driver; this image
+has no GDAL, and S1 preprocessing chains emit NetCDF4 (= HDF5), so h5py is
+the decoder.  Georeferencing comes from a ``geotransform`` attribute
+(root or per-variable) or 1-D ``lat``/``lon`` coordinate variables.
+"""
+
+from __future__ import annotations
+
+import datetime
+import glob
+import logging
+import os
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.types import BandBatch
+from ..engine.protocols import DateObservation
+from ..engine.state import PixelGather
+from ..obsops.wcm import WCMAux, WCMOperator
+from .warp import grid_mapping, resample
+
+LOG = logging.getLogger(__name__)
+
+MISSING_VALUE = -999.0  # Sentinel1_Observations.py:24
+POLARISATIONS = ("VV", "VH")
+
+
+def _read_nc_var(path: str, var: str):
+    """(array, geotransform, crs) for one variable of a NetCDF4 file."""
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        if var not in f:
+            raise KeyError(f"{var} not in {path}")
+        ds = f[var]
+        arr = np.asarray(ds[...], np.float32)
+        gt = None
+        for holder in (ds, f):
+            if "geotransform" in holder.attrs:
+                gt = tuple(float(v) for v in holder.attrs["geotransform"])
+                break
+        crs = None
+        for holder in (ds, f):
+            if "epsg" in holder.attrs:
+                crs = int(holder.attrs["epsg"])
+                break
+        if gt is None and "lat" in f and "lon" in f:
+            lat = np.asarray(f["lat"][...], np.float64)
+            lon = np.asarray(f["lon"][...], np.float64)
+            dx = (lon[-1] - lon[0]) / max(len(lon) - 1, 1)
+            dy = (lat[-1] - lat[0]) / max(len(lat) - 1, 1)
+            gt = (lon[0] - dx / 2, dx, 0.0, lat[0] - dy / 2, 0.0, dy)
+            crs = 4326
+        if gt is None:
+            raise ValueError(
+                f"{path}: no geotransform attribute or lat/lon coords"
+            )
+    return arr, gt, crs
+
+
+class S1Observations:
+    """ObservationSource over a folder of preprocessed S1 sigma0 NetCDFs.
+
+    ``operator`` defaults to the analytic Water-Cloud Model on a
+    (vegetation, soil-moisture) state (``obsops.wcm``), with the scene's
+    per-pixel incidence angle as its aux — the reference injects emulator
+    placeholders per polarisation (``:61``)."""
+
+    def __init__(
+        self,
+        data_folder: str,
+        state_geo,
+        operator: Optional[Any] = None,
+        relative_uncertainty: float = 0.05,
+    ):
+        self.state_geotransform, self.state_crs = state_geo
+        self.operator = operator if operator is not None else WCMOperator()
+        self.relative_uncertainty = float(relative_uncertainty)
+        files = sorted(glob.glob(os.path.join(data_folder, "*.nc")))
+        self.dates: List[datetime.datetime] = []
+        self.date_data: Dict[datetime.datetime, str] = {}
+        for fich in files:
+            splitter = os.path.basename(fich).split("_")
+            this_date = datetime.datetime.strptime(
+                splitter[5], "%Y%m%dT%H%M%S"
+            )
+            self.dates.append(this_date)
+            self.date_data[this_date] = fich
+        self.bands_per_observation = {
+            d: len(POLARISATIONS) for d in self.dates
+        }
+        # One warp mapping per (source grid, dst shape) — shared by
+        # VV/VH/theta of a scene (see sentinel2.py mapping cache).
+        self._mapping_cache: Dict[tuple, tuple] = {}
+
+    def define_output(self):
+        return self.state_crs, list(self.state_geotransform)
+
+    def _warp_var(self, path: str, var: str, dst_shape,
+                  nodata: float) -> np.ndarray:
+        arr, gt, crs = _read_nc_var(path, var)
+        src_crs = crs if crs is not None else self.state_crs
+        key = (tuple(gt), src_crs, tuple(dst_shape))
+        if key not in self._mapping_cache:
+            self._mapping_cache[key] = grid_mapping(
+                gt, dst_shape, self.state_geotransform,
+                src_crs=src_crs, dst_crs=self.state_crs,
+            )
+        col_f, row_f = self._mapping_cache[key]
+        return resample(arr, col_f, row_f, method="nearest", nodata=nodata)
+
+    def get_observations(self, date, gather: PixelGather) -> DateObservation:
+        path = self.date_data[date]
+        dst_shape = gather.mask.shape
+        ys, r_invs, masks = [], [], []
+        for pol in POLARISATIONS:
+            sigma0 = self._warp_var(
+                path, f"sigma0_{pol}", dst_shape, MISSING_VALUE
+            ).astype(np.float32)
+            pix = gather.gather(sigma0, fill=MISSING_VALUE)
+            mask = (
+                (pix != MISSING_VALUE) & np.isfinite(pix) & gather.valid
+            )
+            y = np.where(mask, pix, 0.0).astype(np.float32)
+            sigma = self.relative_uncertainty * y
+            with np.errstate(divide="ignore", invalid="ignore"):
+                r_inv = np.where(mask & (sigma > 0), 1.0 / sigma**2, 0.0)
+            ys.append(y)
+            r_invs.append(r_inv.astype(np.float32))
+            masks.append(mask)
+
+        # Per-pixel incidence angle if the file carries it; otherwise the
+        # reference's hard-coded 23 degrees (sar_forward_model.py:156).
+        try:
+            theta = self._warp_var(path, "theta", dst_shape, 23.0)
+        except KeyError:
+            theta = np.full(dst_shape, 23.0, np.float32)
+        theta_pix = gather.gather(
+            np.where(np.isfinite(theta), theta, 23.0).astype(np.float32),
+            fill=23.0,
+        )
+        aux = WCMAux(theta_deg=jnp.asarray(theta_pix))
+        bands = BandBatch(
+            y=jnp.asarray(np.stack(ys)),
+            r_inv=jnp.asarray(np.stack(r_invs)),
+            mask=jnp.asarray(np.stack(masks)),
+        )
+        return DateObservation(bands=bands, operator=self.operator, aux=aux)

@@ -1,0 +1,289 @@
+"""Reader + warp tests against synthetic on-disk fixture trees (SURVEY.md
+§4: the reference's readers are only exercised by operator-run integration
+scripts; these make them CI-testable)."""
+
+import datetime
+import os
+
+import numpy as np
+import pytest
+
+from kafka_tpu.engine.state import make_pixel_gather
+from kafka_tpu.io.geotiff import GeoInfo, write_geotiff
+from kafka_tpu.io.modis import BHRObservations, TO_BHR
+from kafka_tpu.io.sentinel1 import S1Observations
+from kafka_tpu.io.sentinel2 import BAND_MAP, Sentinel2Observations
+from kafka_tpu.io.warp import (
+    lonlat_to_utm,
+    reproject_raster,
+    utm_to_lonlat,
+)
+from kafka_tpu.obsops import IdentityOperator, TwoStreamOperator
+
+RNG = np.random.default_rng(7)
+
+
+class TestWarp:
+    def test_utm_roundtrip(self):
+        lons = RNG.uniform(-3.2, -2.8, 50)
+        lats = RNG.uniform(38.8, 39.3, 50)
+        e, n = lonlat_to_utm(lons, lats, 32630)
+        lon2, lat2 = utm_to_lonlat(e, n, 32630)
+        np.testing.assert_allclose(lon2, lons, atol=1e-9)
+        np.testing.assert_allclose(lat2, lats, atol=1e-9)
+
+    def test_utm_known_point(self):
+        # Madrid: 40.4168N 3.7038W -> zone 30N ~ (440290, 4474257)
+        e, n = lonlat_to_utm(-3.7038, 40.4168, 32630)
+        assert abs(e - 440290.5) < 1.0
+        assert abs(n - 4474257.4) < 1.0
+
+    def test_identity_warp(self):
+        src = RNG.normal(size=(12, 9)).astype(np.float32)
+        gt = (500000, 10, 0, 4000000, 0, -10)
+        np.testing.assert_array_equal(
+            reproject_raster(src, gt, (12, 9), gt), src
+        )
+
+    def test_shifted_grid_nearest(self):
+        src = np.arange(64, dtype=np.float32).reshape(8, 8)
+        gt = (0, 1, 0, 8, 0, -1)
+        # destination = source shifted by exactly 2 px right/down
+        dst_gt = (2, 1, 0, 6, 0, -1)
+        out = reproject_raster(src, gt, (4, 4), dst_gt, nodata=-1)
+        np.testing.assert_array_equal(out, src[2:6, 2:6])
+
+    def test_bilinear_identity_keeps_edges(self):
+        # A coincident-grid bilinear warp must reproduce the source
+        # exactly, including the last row/column.
+        src = RNG.normal(size=(8, 8)).astype(np.float32)
+        gt = (0, 1, 0, 8, 0, -1)
+        out = reproject_raster(src, gt, (8, 8), gt, method="bilinear",
+                               nodata=-1)
+        np.testing.assert_allclose(out, src, rtol=1e-6)
+
+    def test_bilinear_multiband(self):
+        src = RNG.normal(size=(8, 8, 3)).astype(np.float32)
+        gt = (0, 1, 0, 8, 0, -1)
+        out = reproject_raster(src, gt, (8, 8), gt, method="bilinear",
+                               nodata=-1)
+        assert out.shape == (8, 8, 3)
+        np.testing.assert_allclose(out, src, rtol=1e-6)
+
+    def test_cross_crs_bilinear_constant(self):
+        # A constant field must stay constant under any reprojection.
+        src = np.full((50, 50), 3.25, np.float32)
+        src_gt = (570000, 10, 0, 4325000, 0, -10)
+        lon_c, lat_c = utm_to_lonlat(570250, 4324750, 32630)
+        dst_gt = (lon_c - 0.002, 0.0002, 0, lat_c + 0.0015, 0, -0.00015)
+        out = reproject_raster(src, src_gt, (10, 10), dst_gt,
+                               src_crs=32630, dst_crs=4326,
+                               method="bilinear")
+        assert np.isfinite(out).all()
+        np.testing.assert_allclose(out, 3.25, rtol=1e-6)
+
+
+_S2_XML = """<?xml version="1.0"?>
+<root><Geo><Tile_Angles>
+  <Mean_Sun_Angle>
+    <ZENITH_ANGLE>30.5</ZENITH_ANGLE><AZIMUTH_ANGLE>150.0</AZIMUTH_ANGLE>
+  </Mean_Sun_Angle>
+  <Mean_Viewing_Incidence_Angle_List>
+    <Mean_Viewing_Incidence_Angle bandId="0">
+      <ZENITH_ANGLE>5.0</ZENITH_ANGLE><AZIMUTH_ANGLE>100.0</AZIMUTH_ANGLE>
+    </Mean_Viewing_Incidence_Angle>
+    <Mean_Viewing_Incidence_Angle bandId="1">
+      <ZENITH_ANGLE>7.0</ZENITH_ANGLE><AZIMUTH_ANGLE>110.0</AZIMUTH_ANGLE>
+    </Mean_Viewing_Incidence_Angle>
+  </Mean_Viewing_Incidence_Angle_List>
+</Tile_Angles></Geo></root>
+"""
+
+GT = (577000.0, 10.0, 0.0, 4323000.0, 0.0, -10.0)
+NY, NX = 12, 16
+
+
+def _make_s2_tree(root):
+    gran = os.path.join(root, "2017", "7", "5", "S2A_GRANULE")
+    os.makedirs(gran)
+    geo = GeoInfo(geotransform=GT, epsg=32630)
+    for b in BAND_MAP:
+        refl = RNG.integers(500, 5000, (NY, NX)).astype(np.int32)
+        refl[0, :] = 0  # a nodata row
+        write_geotiff(os.path.join(gran, f"B{b}_sur.tif"),
+                      refl.astype(np.float32), geo)
+    write_geotiff(os.path.join(gran, "xxx_aot.tif"),
+                  np.ones((NY, NX), np.float32), geo)
+    with open(os.path.join(gran, "metadata.xml"), "w") as f:
+        f.write(_S2_XML)
+    return gran
+
+
+class TestSentinel2:
+    def test_discovery_and_band_data(self, tmp_path):
+        _make_s2_tree(str(tmp_path))
+        op = IdentityOperator(n_params=10,
+                              obs_indices=tuple(range(10)))
+        s2 = Sentinel2Observations(str(tmp_path), op, (GT, 32630))
+        assert s2.dates == [datetime.datetime(2017, 7, 5)]
+        assert s2.bands_per_observation[s2.dates[0]] == 10
+
+        gather = make_pixel_gather(np.ones((NY, NX), bool), pad_multiple=64)
+        obs = s2.get_observations(s2.dates[0], gather)
+        y = np.asarray(obs.bands.y)
+        mask = np.asarray(obs.bands.mask)
+        r_inv = np.asarray(obs.bands.r_inv)
+        assert y.shape == (10, gather.n_pad)
+        # Scaling: reflectances in (0, 1]; nodata row masked out.
+        assert (y[mask] > 0).all() and (y[mask] <= 0.5).all()
+        nodata_pix = gather.gather(
+            np.arange(NY * NX).reshape(NY, NX)
+        ) < NX  # first raster row
+        assert not mask[:, nodata_pix].any()
+        # r_inv = 1/(0.05 y)^2 on valid pixels
+        np.testing.assert_allclose(
+            r_inv[mask], 1.0 / (0.05 * y[mask]) ** 2, rtol=1e-5
+        )
+        assert obs.aux["sza"] == 30.5
+        assert obs.aux["vza"] == 6.0  # mean of 5 and 7
+
+    def test_missing_folder_raises(self):
+        with pytest.raises(IOError):
+            Sentinel2Observations("/nonexistent/path",
+                                  None, (GT, 32630))
+
+    def test_geometry_bank_selection(self):
+        from kafka_tpu.io.sentinel2 import (
+            find_nearest_geometry,
+            geometry_bank_aux_builder,
+        )
+
+        banks = {(30.0, 0.0, 50.0): "a", (30.0, 10.0, 50.0): "b",
+                 (40.0, 10.0, 100.0): "c"}
+        key = find_nearest_geometry(banks.keys(), 31.0, 9.0, 60.0)
+        assert key == (30.0, 10.0, 50.0)
+        build = geometry_bank_aux_builder(banks)
+        meta = {"sza": 39.0, "vza": 11.0, "saa": 100.0, "vaa": 195.0}
+        assert build(meta, None) == "c"
+
+
+class TestS1ThetaFallback:
+    def test_missing_theta_defaults_to_23deg(self, tmp_path):
+        import h5py
+
+        fname = "S1A_IW_GRDH_1SDV_pre_20170705T175515_y_z.nc"
+        with h5py.File(str(tmp_path / fname), "w") as f:
+            for pol in ("VV", "VH"):
+                f.create_dataset(
+                    f"sigma0_{pol}",
+                    data=RNG.uniform(0.01, 0.3, (NY, NX)).astype(np.float32),
+                )
+            f.attrs["geotransform"] = np.array(GT)
+            f.attrs["epsg"] = 32630
+        s1 = S1Observations(str(tmp_path), (GT, 32630))
+        gather = make_pixel_gather(np.ones((NY, NX), bool), pad_multiple=64)
+        obs = s1.get_observations(s1.dates[0], gather)
+        np.testing.assert_allclose(np.asarray(obs.aux.theta_deg), 23.0)
+
+
+def _make_s1_file(path):
+    import h5py
+
+    ny, nx = NY, NX
+    with h5py.File(path, "w") as f:
+        for pol in ("VV", "VH"):
+            s0 = RNG.uniform(0.01, 0.3, (ny, nx)).astype(np.float32)
+            s0[:, 0] = -999.0
+            f.create_dataset(f"sigma0_{pol}", data=s0)
+        f.create_dataset(
+            "theta", data=np.full((ny, nx), 37.5, np.float32)
+        )
+        f.attrs["geotransform"] = np.array(GT)
+        f.attrs["epsg"] = 32630
+
+
+class TestSentinel1:
+    def test_discovery_and_band_data(self, tmp_path):
+        # date in filename field 5, the reference's convention
+        # (Sentinel1_Observations.py:74-78)
+        fname = "S1A_IW_GRDH_1SDV_pre_20170705T175515_y_z.nc"
+        _make_s1_file(str(tmp_path / fname))
+        s1 = S1Observations(str(tmp_path), (GT, 32630))
+        assert s1.dates == [datetime.datetime(2017, 7, 5, 17, 55, 15)]
+
+        gather = make_pixel_gather(np.ones((NY, NX), bool), pad_multiple=64)
+        obs = s1.get_observations(s1.dates[0], gather)
+        y = np.asarray(obs.bands.y)
+        mask = np.asarray(obs.bands.mask)
+        assert y.shape == (2, gather.n_pad)
+        # -999 column masked
+        col0 = gather.gather(
+            np.tile(np.arange(NX), (NY, 1))
+        ) == 0
+        assert not mask[:, col0].any()
+        assert mask[:, ~col0 & gather.valid].all()
+        # incidence angle rides aux
+        theta = np.asarray(obs.aux.theta_deg)
+        np.testing.assert_allclose(theta[gather.valid], 37.5)
+
+
+def _make_modis_dir(root, dates):
+    geo = GeoInfo(geotransform=GT, epsg=32630)
+    truth = {}
+    for d in dates:
+        stem = f"MCD43_A{d.strftime('%Y%j')}"
+        for band in ("vis", "nir"):
+            k = RNG.uniform(0.0, 0.5, (NY, NX, 3)).astype(np.float32)
+            qa = np.zeros((NY, NX), np.uint8)
+            qa[:, -2:] = 1     # magnitude inversion
+            qa[0, :] = 255     # fill
+            write_geotiff(os.path.join(root, f"{stem}_{band}_kernels.tif"),
+                          k, geo)
+            write_geotiff(os.path.join(root, f"{stem}_{band}_qa.tif"),
+                          qa, geo)
+            truth[(d, band)] = (k, qa)
+    return truth
+
+
+class TestMODIS:
+    def test_thinning_and_band_data(self, tmp_path):
+        dates = [
+            datetime.datetime(2017, 1, 1) + datetime.timedelta(days=i)
+            for i in range(0, 48)
+        ]
+        truth = _make_modis_dir(str(tmp_path), dates)
+        op = TwoStreamOperator()
+        bhr = BHRObservations(str(tmp_path), op, period=16)
+        assert len(bhr.dates) == 3  # 48 days thinned by 16
+
+        gather = make_pixel_gather(np.ones((NY, NX), bool), pad_multiple=64)
+        obs = bhr.get_observations(bhr.dates[0], gather)
+        y = np.asarray(obs.bands.y)
+        mask = np.asarray(obs.bands.mask)
+        r_inv = np.asarray(obs.bands.r_inv)
+        assert y.shape == (2, gather.n_pad)
+        k, qa = truth[(bhr.dates[0], "vis")]
+        expected = (k.reshape(-1, 3) @ TO_BHR).astype(np.float32)
+        qa_flat = qa.reshape(-1)
+        valid = qa_flat <= 1
+        np.testing.assert_allclose(
+            y[0, : NY * NX][valid], expected[valid], rtol=1e-5
+        )
+        assert not mask[:, : NY * NX][:, qa_flat == 255].any()
+        # QA 1 pixels get the 7% sigma
+        qa1 = (qa_flat == 1) & (expected > 2.5e-3 / 0.07)
+        if qa1.any():
+            np.testing.assert_allclose(
+                r_inv[0, : NY * NX][qa1],
+                1.0 / np.maximum(2.5e-3, expected[qa1] * 0.07) ** 2,
+                rtol=1e-4,
+            )
+
+    def test_roi_window(self, tmp_path):
+        dates = [datetime.datetime(2017, 1, 1)]
+        _make_modis_dir(str(tmp_path), dates)
+        bhr = BHRObservations(str(tmp_path), TwoStreamOperator(), period=1)
+        bhr.apply_roi(2, 1, 10, 7)
+        gather = make_pixel_gather(np.ones((6, 8), bool), pad_multiple=64)
+        obs = bhr.get_observations(bhr.dates[0], gather)
+        assert np.asarray(obs.bands.y).shape == (2, gather.n_pad)
