@@ -1,0 +1,366 @@
+"""Differentiable PROSAIL-family canopy reflectance operator (JAX).
+
+The reference's Sentinel-2 path inverts PROSAIL through pickled
+per-band/per-geometry GP emulators
+(``/root/reference/kafka/inference/utils.py:181-219``,
+``Sentinel2_Observations.py:157-159``) on a 10-parameter transformed state
+(``kafka_test_S2.py:136-137``):
+
+    [n, cab, car, cbrown, cw, cm, lai, ala, bsoil, psoil]
+
+with exponential transforms for the absorbing constituents and
+``tlai = exp(-lai/2)`` (``kafka_test_S2.py:84-92``).  The pickles are not
+reproducible artifacts, so this module provides the physics itself as a
+pure JAX function — exactly differentiable, jit/vmap-native, no emulator
+required (the GP/MLP machinery in ``obsops/gp.py``/``mlp.py`` remains
+available to emulate *this* model or any external one).
+
+Model structure (all closed-form, fully differentiable):
+
+1. **Leaf optics — generalized plate model** (Allen/Stokes; the PROSPECT
+   construction): per-layer absorption ``k`` from the constituent
+   contents, elementary-layer transmissivity
+   ``theta = (1-k)e^{-k} + k^2 E1(k)`` with the exponential integral
+   ``E1`` via Abramowitz-Stegun approximations, Fresnel interface
+   transmittances ``tav`` integrated numerically on the host (constants
+   per band), and the Stokes N-layer system in its eigenvalue closed form.
+2. **Canopy BRF — SAIL-family two-stream + single scattering**:
+   Ross-Goudriaan G-functions from the average leaf angle, exact
+   single-scattering term with a Kuusk-style hotspot factor, two-stream
+   multiple scattering over a Lambertian soil, linear dry/wet soil mixing
+   weighted by ``bsoil``/``psoil``.
+
+The per-band constituent absorption coefficients below are *band-effective*
+values for the 10 S2 bands of the reference's band map (B02..B8A, B09,
+B12) — the spectral shape of PROSPECT-5 averaged into bands.  They carry
+the correct physics structure (which is what the Jacobians see); absolute
+calibration against a full-spectrum PROSAIL run can refit ``BAND_K`` /
+``N_REFRACT`` without touching the model code.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .protocol import ObservationModel
+
+_EPS = 1e-6
+
+# ---------------------------------------------------------------------------
+# Per-band constants (10 bands: B02 B03 B04 B05 B06 B07 B08 B8A B09 B12).
+# ---------------------------------------------------------------------------
+
+#: Band centre wavelengths (nm), the reference band map order
+#: (``Sentinel2_Observations.py:93-94``).
+BAND_WAVELENGTHS = np.array(
+    [490.0, 560.0, 665.0, 705.0, 740.0, 783.0, 842.0, 865.0, 945.0, 2190.0]
+)
+
+#: Leaf refractive index per band (PROSPECT's n(lambda), band-averaged).
+N_REFRACT = np.array(
+    [1.53, 1.52, 1.50, 1.49, 1.48, 1.47, 1.46, 1.46, 1.45, 1.40]
+)
+
+#: Band-effective specific absorption per constituent:
+#: rows = (cab [ug/cm2]^-1, car [ug/cm2]^-1, cbrown [-], cw [cm]^-1,
+#: cm [g/cm2]^-1).  Shapes follow PROSPECT-5: chlorophyll in blue/red with
+#: the red-edge shoulder, carotenoids in blue only, brown pigment decaying
+#: from blue, water and dry matter in the SWIR.
+BAND_K = np.array([
+    # B02    B03    B04    B05    B06    B07    B08    B8A    B09    B12
+    [0.045, 0.018, 0.062, 0.028, 0.006, 0.000, 0.000, 0.000, 0.000, 0.000],
+    [0.060, 0.008, 0.000, 0.000, 0.000, 0.000, 0.000, 0.000, 0.000, 0.000],
+    [0.900, 0.450, 0.180, 0.100, 0.060, 0.040, 0.020, 0.015, 0.008, 0.000],
+    [0.000, 0.000, 0.000, 0.001, 0.002, 0.004, 0.008, 0.012, 0.450, 32.00],
+    [0.000, 0.000, 0.000, 0.000, 0.500, 0.800, 1.200, 1.400, 2.500, 55.00],
+])
+
+#: Typical dry/wet soil reflectance spectra at the 10 bands (linear mixing
+#: weighted by psoil, scaled by bsoil — the PROSAIL soil model).
+SOIL_DRY = np.array(
+    [0.12, 0.15, 0.19, 0.22, 0.24, 0.26, 0.28, 0.29, 0.31, 0.38]
+)
+SOIL_WET = np.array(
+    [0.06, 0.08, 0.10, 0.12, 0.13, 0.14, 0.15, 0.16, 0.17, 0.15]
+)
+
+
+def _tav_host(alpha_deg: float, n: np.ndarray) -> np.ndarray:
+    """Average Fresnel transmittance of the air->leaf interface for
+    radiation within a cone of half-angle ``alpha`` — PROSPECT's ``tav``,
+    computed by direct numerical integration on the host (exact; the
+    published closed form is an analytic antiderivative of this).  Only
+    needed for per-band constants, never traced."""
+    theta = np.linspace(0.0, np.deg2rad(alpha_deg), 512)[None, :]  # (1, t)
+    n = np.asarray(n, np.float64)[:, None]                         # (b, 1)
+    sin_t = np.sin(theta)
+    cos_t = np.cos(theta)
+    sin_r = np.clip(sin_t / n, 0.0, 1.0)
+    cos_r = np.sqrt(1.0 - sin_r**2)
+    # Fresnel reflectances, unpolarised average, entering the denser medium
+    rs = ((cos_t - n * cos_r) / (cos_t + n * cos_r)) ** 2
+    rp = ((n * cos_t - cos_r) / (n * cos_t + cos_r)) ** 2
+    t = 1.0 - 0.5 * (rs + rp)
+    w = sin_t * cos_t
+    return (t * w).sum(axis=1) / np.maximum(w.sum(), 1e-12)
+
+
+_TAV40 = _tav_host(40.0, N_REFRACT)
+_TAV90 = _tav_host(90.0, N_REFRACT)
+
+
+def expint_e1(x):
+    """Exponential integral E1(x) for x > 0 (Abramowitz & Stegun 5.1.53 /
+    5.1.56), branch-free for jit."""
+    x = jnp.maximum(x, 1e-8)
+    # series for x <= 1
+    a = jnp.array([-0.57721566, 0.99999193, -0.24991055,
+                   0.05519968, -0.00976004, 0.00107857])
+    xs = jnp.minimum(x, 1.0)
+    small = (
+        a[0] + xs * (a[1] + xs * (a[2] + xs * (a[3] + xs * (a[4] + xs * a[5]))))
+        - jnp.log(xs)
+    )
+    # rational for x >= 1
+    xl = jnp.maximum(x, 1.0)
+    num = xl * xl + 2.334733 * xl + 0.250621
+    den = xl * xl + 3.330657 * xl + 1.681534
+    large = jnp.exp(-xl) / xl * num / den
+    return jnp.where(x <= 1.0, small, large)
+
+
+def plate_model(k, tav_alpha, tav90, n, n_layers):
+    """Leaf reflectance/transmittance from per-layer absorption ``k`` —
+    the generalized plate model in its Stokes closed form (the PROSPECT
+    construction).  All inputs broadcast per band."""
+    k = jnp.maximum(k, _EPS)
+    trans = (1.0 - k) * jnp.exp(-k) + k**2 * expint_e1(k)
+    trans = jnp.clip(trans, _EPS, 1.0 - _EPS)
+
+    t21 = tav90 / n**2
+    r21 = 1.0 - t21
+    r12 = 1.0 - tav90
+    talf = tav_alpha
+    ralf = 1.0 - talf
+    denom = 1.0 - r21**2 * trans**2
+    ta = talf * trans * t21 / denom
+    ra = ralf + r21 * trans * ta
+    t = tav90 * trans * t21 / denom
+    r = r12 + r21 * trans * t
+
+    # Stokes system for the remaining N-1 layers (eigenvalue form).
+    t = jnp.clip(t, _EPS, 1.0 - _EPS)
+    r = jnp.clip(r, _EPS, 1.0 - _EPS)
+    d = jnp.sqrt(jnp.maximum(
+        ((1.0 + r + t) * (1.0 + r - t) * (1.0 - r + t) * (1.0 - r - t)),
+        _EPS**2,
+    ))
+    rq, tq = r**2, t**2
+    a = (1.0 + rq - tq + d) / (2.0 * r)
+    b = (1.0 - rq + tq + d) / (2.0 * t)
+    m = jnp.maximum(n_layers - 1.0, _EPS)
+    bnm1 = jnp.power(jnp.maximum(b, 1.0 + _EPS), m)
+    bn2 = bnm1**2
+    a2 = a**2
+    denom2 = a2 * bn2 - 1.0
+    rsub = a * (bn2 - 1.0) / denom2
+    tsub = bnm1 * (a2 - 1.0) / denom2
+
+    denom3 = 1.0 - rsub * r
+    tran = ta * tsub / denom3
+    refl = ra + ta * rsub * t / denom3
+    return jnp.clip(refl, 0.0, 1.0), jnp.clip(tran, 0.0, 1.0)
+
+
+def leaf_optics(n_layers, cab, car, cbrown, cw, cm):
+    """(rho, tau) per band from the constituent contents."""
+    kk = jnp.asarray(BAND_K, jnp.float32)
+    contents = jnp.stack([cab, car, cbrown, cw, cm])
+    k = (kk * contents[:, None]).sum(axis=0) / jnp.maximum(n_layers, 1.0)
+    return plate_model(
+        k,
+        jnp.asarray(_TAV40, jnp.float32),
+        jnp.asarray(_TAV90, jnp.float32),
+        jnp.asarray(N_REFRACT, jnp.float32),
+        n_layers,
+    )
+
+
+def g_function(theta, chi_l):
+    """Ross-Goudriaan projection function G(theta) for a leaf angle
+    distribution with Ross index ``chi_l`` (0 = spherical, +1 planophile,
+    -1 erectophile)."""
+    phi1 = 0.5 - 0.633 * chi_l - 0.33 * chi_l**2
+    phi2 = 0.877 * (1.0 - 2.0 * phi1)
+    return phi1 + phi2 * jnp.cos(theta)
+
+
+def ala_to_chi(ala_deg):
+    """Average leaf angle (deg) -> Ross-Goudriaan index.  Spherical LIDF
+    has ALA ~ 57.3 deg <-> chi 0; planophile (horizontal) -> +1,
+    erectophile (vertical) -> -1 (linear map, clipped to the valid
+    Ross-Goudriaan range)."""
+    return jnp.clip((57.3 - ala_deg) / 57.3, -0.4, 0.6)
+
+
+def canopy_brf(rho_l, tau_l, soil, lai, ala_deg, sza_deg, vza_deg, raa_deg,
+               hotspot: float = 0.01):
+    """Top-of-canopy bidirectional reflectance factor per band.
+
+    SAIL-family decomposition: exact single scattering (sun -> leaf ->
+    view, with Kuusk hotspot correlation) + two-stream multiple scattering
+    + direct soil term.
+    """
+    ts = jnp.deg2rad(sza_deg)
+    to = jnp.deg2rad(vza_deg)
+    psi = jnp.deg2rad(raa_deg)
+    mu_s = jnp.clip(jnp.cos(ts), 0.05, 1.0)
+    mu_o = jnp.clip(jnp.cos(to), 0.05, 1.0)
+    lai = jnp.maximum(lai, _EPS)
+
+    chi = ala_to_chi(ala_deg)
+    gs = g_function(ts, chi)
+    go = g_function(to, chi)
+    ks = gs / mu_s           # directional extinction coefficients
+    ko = go / mu_o
+
+    # Scattering phase: bi-Lambertian leaf, area-scattering approximation
+    # (Ross): fraction of intercepted flux scattered sun->view.
+    cos_scatter = (
+        jnp.cos(ts) * jnp.cos(to) + jnp.sin(ts) * jnp.sin(to) * jnp.cos(psi)
+    )
+    w = rho_l + tau_l                              # single-scatter albedo
+    gamma = 0.125 * (
+        w * (1.0 + cos_scatter) + (rho_l - tau_l) * (1.0 - cos_scatter)
+    )
+
+    # Kuusk hotspot: correlation between sun and view gap fractions.
+    delta = jnp.sqrt(
+        jnp.maximum(
+            jnp.tan(ts) ** 2 + jnp.tan(to) ** 2
+            - 2.0 * jnp.tan(ts) * jnp.tan(to) * jnp.cos(psi),
+            0.0,
+        )
+    )
+    alpha_h = jnp.maximum(delta / jnp.maximum(hotspot, 1e-4), 1e-6)
+    # overlap integral approximation (exponential form): full correlation
+    # sqrt(ks ko) L in the exact backscatter direction, decaying with
+    # angular distance from it.
+    c_hs = jnp.sqrt(ks * ko) * lai * (1.0 - jnp.exp(-alpha_h)) / alpha_h
+    # Single scattering over black soil with hotspot-corrected two-way
+    # extinction: integral_0^L gamma e^{-(ks+ko) x + C(x)} dx, approximated
+    # by deflating (ks+ko) with the correlation fraction f_hs.
+    f_hs = c_hs / jnp.maximum((ks + ko) * lai, _EPS)
+    k_two = (ks + ko) * (1.0 - f_hs)
+    brf_ss = gamma * (1.0 - jnp.exp(-k_two * lai)) / jnp.maximum(k_two, _EPS)
+    # view gap fraction and correlated two-way soil transmittance
+    tau_oo = jnp.exp(-ko * lai)
+    # correlated two-way soil transmittance (hotspot raises it)
+    tau_sso = jnp.exp(-k_two * lai)
+
+    # Multiple scattering: two-flux (Kubelka-Munk) with diffuse extinction
+    # ~ G_bar / mu_bar, isotropic backscatter fraction from leaf optics.
+    att = 1.0 - 0.5 * w * (1.0 + _DIFF_BACK)      # alpha
+    bsc = 0.5 * w * _DIFF_BACK                    # beta
+    gam2 = jnp.sqrt(jnp.maximum(att**2 - bsc**2, _EPS**2))
+    r_inf = bsc / (att + gam2)
+    e_m = jnp.exp(-2.0 * gam2 * lai)              # diffuse path ~ 2 LAI
+    ratio = e_m * (r_inf - soil) / (soil - 1.0 / jnp.maximum(r_inf, _EPS))
+    c1 = 1.0 / (1.0 + ratio)
+    c2 = ratio * c1
+    r_dd = r_inf * c1 + c2 / jnp.maximum(r_inf, _EPS)
+    # diffuse (multiple-scatter) contribution reaching the viewer: total
+    # diffuse albedo minus what single scattering already accounted for,
+    # weighted by canopy interception along the view path
+    brf_ms = jnp.clip(
+        r_dd - gamma * (1.0 - jnp.exp(-2.0 * gam2 * lai))
+        / jnp.maximum(2.0 * gam2, _EPS),
+        0.0, 1.0,
+    ) * (1.0 - tau_oo)
+    # soil direct term seen through correlated gaps
+    brf_soil = soil * tau_sso
+
+    brf = brf_ss + brf_ms + brf_soil
+    return jnp.clip(brf, 0.0, 1.0)
+
+
+#: Diffuse backscatter fraction for the two-flux multiple-scattering term
+#: (isotropic leaf orientation average).
+_DIFF_BACK = 0.5
+
+
+class ProsailAux(NamedTuple):
+    """Per-date acquisition geometry (degrees), broadcast or per pixel."""
+
+    sza: jnp.ndarray
+    vza: jnp.ndarray
+    raa: jnp.ndarray
+
+
+#: The 10-parameter transformed state of the reference S2 config
+#: (``kafka_test_S2.py:136-137``).
+PROSAIL_PARAMETER_LIST = (
+    "n", "cab", "car", "cbrown", "cw", "cm", "lai", "ala", "bsoil", "psoil",
+)
+
+
+def inverse_transforms(x):
+    """Transformed state -> physical PROSAIL quantities
+    (``kafka_test_S2.py:84-92``: cab/car/cm/cw/lai live in exponential
+    spaces, ala in [0,1] of 90 deg)."""
+    n = 1.0 + 2.0 * jnp.clip(x[0] - 1.0, 0.0, 1.0)       # plate layers 1..3
+    cab = -100.0 * jnp.log(jnp.clip(x[1], _EPS, 1.0 - _EPS))
+    car = -100.0 * jnp.log(jnp.clip(x[2], _EPS, 1.0 - _EPS))
+    cbrown = jnp.clip(x[3], 0.0, 1.0)
+    cw = -(1.0 / 50.0) * jnp.log(jnp.clip(x[4], _EPS, 1.0 - _EPS))
+    cm = -(1.0 / 100.0) * jnp.log(jnp.clip(x[5], _EPS, 1.0 - _EPS))
+    lai = -2.0 * jnp.log(jnp.clip(x[6], _EPS, 1.0 - _EPS))
+    ala = 90.0 * jnp.clip(x[7], 0.0, 1.0)
+    bsoil = jnp.maximum(x[8], 0.0)
+    psoil = jnp.clip(x[9], 0.0, 1.0)
+    return n, cab, car, cbrown, cw, cm, lai, ala, bsoil, psoil
+
+
+class ProsailOperator(ObservationModel):
+    """10-band S2 reflectance operator on the transformed PROSAIL state —
+    the self-contained, differentiable replacement for the reference's
+    pickled PROSAIL emulators (``inference/utils.py:181-219``)."""
+
+    n_bands = 10
+    n_params = 10
+    #: transformed-space domain: exponential-transform params in (0, 1),
+    #: n in [1, 3] (encoded 1..2 pre-transform), ala fraction in (0, 1),
+    #: bsoil in (0, 2], psoil in (0, 1).
+    state_bounds = (
+        np.array([1.0, 5e-3, 5e-3, 0.0, 5e-3, 5e-3, 5e-3, 0.02, 0.0, 0.0],
+                 np.float32),
+        np.array([2.0, 0.999, 0.999, 1.0, 0.999, 0.999, 0.999, 0.98, 2.0,
+                  1.0], np.float32),
+    )
+
+    def __init__(self, hotspot: float = 0.01):
+        self.hotspot = float(hotspot)
+
+    def forward_pixel(self, aux: Optional[ProsailAux], x_pixel):
+        if aux is None:
+            aux = ProsailAux(
+                sza=jnp.asarray(30.0), vza=jnp.asarray(0.0),
+                raa=jnp.asarray(0.0),
+            )
+        n, cab, car, cbrown, cw, cm, lai, ala, bsoil, psoil = (
+            inverse_transforms(x_pixel)
+        )
+        rho_l, tau_l = leaf_optics(n, cab, car, cbrown, cw, cm)
+        soil = bsoil * (
+            psoil * jnp.asarray(SOIL_DRY, jnp.float32)
+            + (1.0 - psoil) * jnp.asarray(SOIL_WET, jnp.float32)
+        )
+        soil = jnp.clip(soil, 0.0, 1.0)
+        return canopy_brf(
+            rho_l, tau_l, soil, lai, ala, aux.sza, aux.vza, aux.raa,
+            hotspot=self.hotspot,
+        )

@@ -1,0 +1,183 @@
+"""Physics-invariant tests for the differentiable PROSAIL-family operator.
+
+The reference's PROSAIL path is only exercised through unpicklable GP
+emulators; these tests pin the *physics* of the in-repo replacement:
+bounds, limits (bare soil / dense canopy), spectral shape (red edge,
+chlorophyll absorption), hotspot behavior, and Jacobian finiteness.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kafka_tpu.obsops.prosail import (
+    PROSAIL_PARAMETER_LIST,
+    ProsailAux,
+    ProsailOperator,
+    SOIL_DRY,
+    SOIL_WET,
+    expint_e1,
+    inverse_transforms,
+    leaf_optics,
+)
+
+
+def make_state(lai=2.0, cab=40.0, n=1.5, ala=57.0, bsoil=1.0, psoil=0.5,
+               car=8.0, cbrown=0.05, cw=0.012, cm=0.005):
+    """Physical values -> transformed state vector."""
+    return jnp.asarray([
+        n, np.exp(-cab / 100.0), np.exp(-car / 100.0), cbrown,
+        np.exp(-50.0 * cw), np.exp(-100.0 * cm), np.exp(-lai / 2.0),
+        ala / 90.0, bsoil, psoil,
+    ], jnp.float32)
+
+
+AUX = ProsailAux(sza=jnp.asarray(30.0), vza=jnp.asarray(5.0),
+                 raa=jnp.asarray(90.0))
+OP = ProsailOperator()
+
+
+class TestExpint:
+    def test_against_scipy(self):
+        from scipy.special import exp1
+
+        x = np.logspace(-3, 1.5, 40)
+        got = np.asarray(expint_e1(jnp.asarray(x, jnp.float32)))
+        np.testing.assert_allclose(got, exp1(x), rtol=5e-3, atol=1e-6)
+
+
+class TestLeafOptics:
+    def test_energy_conservation(self):
+        rho, tau = leaf_optics(
+            jnp.asarray(1.5), jnp.asarray(40.0), jnp.asarray(8.0),
+            jnp.asarray(0.0), jnp.asarray(0.01), jnp.asarray(0.005),
+        )
+        rho, tau = np.asarray(rho), np.asarray(tau)
+        assert (rho >= 0).all() and (tau >= 0).all()
+        assert (rho + tau <= 1.0).all()
+
+    def test_chlorophyll_darkens_red_not_nir(self):
+        args = lambda cab: (
+            jnp.asarray(1.5), jnp.asarray(cab), jnp.asarray(8.0),
+            jnp.asarray(0.0), jnp.asarray(0.01), jnp.asarray(0.005),
+        )
+        rho_lo, _ = leaf_optics(*args(10.0))
+        rho_hi, _ = leaf_optics(*args(70.0))
+        # band 2 = B04 red: strong absorption difference
+        assert float(rho_hi[2]) < float(rho_lo[2]) - 0.02
+        # band 6 = B08 NIR: chlorophyll-transparent
+        assert abs(float(rho_hi[6]) - float(rho_lo[6])) < 0.01
+
+    def test_water_darkens_swir(self):
+        args = lambda cw: (
+            jnp.asarray(1.5), jnp.asarray(40.0), jnp.asarray(8.0),
+            jnp.asarray(0.0), jnp.asarray(cw), jnp.asarray(0.005),
+        )
+        rho_dry, _ = leaf_optics(*args(0.002))
+        rho_wet, _ = leaf_optics(*args(0.03))
+        assert float(rho_wet[9]) < float(rho_dry[9]) - 0.02  # B12
+
+
+class TestCanopyBRF:
+    def test_bounds_and_finite(self):
+        rng = np.random.default_rng(0)
+        lo, hi = OP.state_bounds
+        xs = jnp.asarray(
+            rng.uniform(lo, hi, (256, 10)).astype(np.float32)
+        )
+        brf = np.asarray(OP.forward(AUX, xs))
+        assert np.isfinite(brf).all()
+        assert (brf >= 0).all() and (brf <= 1).all()
+
+    def test_bare_soil_limit(self):
+        """LAI -> 0: BRF must converge to the mixed soil spectrum."""
+        x = make_state(lai=1e-4, bsoil=1.0, psoil=0.7)
+        brf = np.asarray(OP.forward_pixel(AUX, x))
+        soil = 1.0 * (0.7 * SOIL_DRY + 0.3 * SOIL_WET)
+        np.testing.assert_allclose(brf, soil, atol=0.01)
+
+    def test_dense_canopy_ignores_soil(self):
+        """LAI -> large: soil brightness must stop mattering."""
+        b1 = np.asarray(OP.forward_pixel(AUX, make_state(lai=8.0, bsoil=0.2)))
+        b2 = np.asarray(OP.forward_pixel(AUX, make_state(lai=8.0, bsoil=1.8)))
+        np.testing.assert_allclose(b1, b2, atol=0.01)
+
+    def test_red_edge(self):
+        """A vegetated canopy must be much brighter in NIR than red."""
+        brf = np.asarray(OP.forward_pixel(AUX, make_state(lai=4.0, cab=50.0)))
+        red, nir = brf[2], brf[6]
+        assert nir > 2.0 * red
+
+    def test_hotspot_brightening(self):
+        """Backscatter geometry (view == sun) must be brighter than a
+        well-separated geometry at the same angles."""
+        x = make_state(lai=3.0)
+        hot = ProsailAux(sza=jnp.asarray(30.0), vza=jnp.asarray(30.0),
+                         raa=jnp.asarray(0.0))
+        cold = ProsailAux(sza=jnp.asarray(30.0), vza=jnp.asarray(30.0),
+                          raa=jnp.asarray(180.0))
+        b_hot = np.asarray(OP.forward_pixel(hot, x))
+        b_cold = np.asarray(OP.forward_pixel(cold, x))
+        assert (b_hot >= b_cold - 1e-6).all()
+        assert b_hot[6] > b_cold[6]  # visible in the NIR
+
+    def test_jacobian_finite_and_informative(self):
+        x = make_state()
+        lin = OP.linearize(AUX, x[None, :])
+        jac = np.asarray(lin.jac)
+        assert np.isfinite(jac).all()
+        # TLAI (slot 6) must influence the NIR band
+        assert abs(jac[6, 0, 6]) > 1e-3
+
+    def test_parameter_list_matches_state(self):
+        assert len(PROSAIL_PARAMETER_LIST) == OP.n_params
+
+    def test_inverse_transforms_roundtrip(self):
+        x = make_state(lai=2.5, cab=33.0, cw=0.015, cm=0.007, ala=45.0)
+        n, cab, car, cbrown, cw, cm, lai, ala, *_ = [
+            float(v) for v in inverse_transforms(x)
+        ]
+        assert abs(lai - 2.5) < 1e-3
+        assert abs(cab - 33.0) < 0.05
+        assert abs(cw - 0.015) < 1e-5
+        assert abs(ala - 45.0) < 0.05
+
+
+class TestAssimilation:
+    def test_recover_lai_from_reflectance(self):
+        """End-to-end sanity: generate reflectances from a known LAI and
+        invert; the posterior TLAI must move toward the truth."""
+        from kafka_tpu.core.solvers import iterated_solve
+        from kafka_tpu.core.types import BandBatch
+        from kafka_tpu.engine.priors import sail_prior
+
+        truth = make_state(lai=3.0)
+        prior = sail_prior()
+        n_pix = 32
+        y_true = np.asarray(OP.forward(AUX, jnp.tile(truth, (n_pix, 1))))
+        rng = np.random.default_rng(1)
+        y = y_true + rng.normal(0, 0.005, y_true.shape).astype(np.float32)
+        r_inv = np.full_like(y, 1.0 / 0.005**2)
+        bands = BandBatch(
+            y=jnp.asarray(y), r_inv=jnp.asarray(r_inv),
+            mask=jnp.ones_like(jnp.asarray(y), bool),
+        )
+        x0, p_inv0 = prior.process_prior(None, _FakeGather(n_pix))
+        bounds = (jnp.asarray(OP.state_bounds[0]),
+                  jnp.asarray(OP.state_bounds[1]))
+        def linearize(aux, xx):
+            return OP.linearize(AUX, xx)
+        x, p_inv, diags = iterated_solve(
+            linearize, bands, x0, p_inv0, None, state_bounds=bounds,
+        )
+        tlai_prior = float(np.asarray(x0)[0, 6])
+        tlai_post = float(np.asarray(x)[:, 6].mean())
+        tlai_true = float(truth[6])
+        assert abs(tlai_post - tlai_true) < abs(tlai_prior - tlai_true)
+        assert np.isfinite(np.asarray(x)).all()
+
+
+class _FakeGather:
+    def __init__(self, n_pad):
+        self.n_pad = n_pad
