@@ -87,15 +87,24 @@ def bench_oracle(n_pix: int, reps: int = 1) -> float:
 
 
 def main():
-    # Baseline on the reference's chunk size; device on a full-tile-scale
-    # batch (batched-dense path is chunk-size-agnostic).
-    base_px_s = bench_oracle(16384)
-    dev_px_s = bench_device(1 << 19)
+    # Baseline on the reference's chunk size (16384 px = one 128x128
+    # chunk).  vs_baseline compares both backends at that SAME size so it
+    # measures the backend, not batch scaling; the headline value is the
+    # device's full-tile-scale throughput (its realistic operating point),
+    # with both sizes reported.
+    n_matched = 16384
+    n_device = 1 << 19
+    base_px_s = bench_oracle(n_matched)
+    dev_matched_px_s = bench_device(n_matched)
+    dev_px_s = bench_device(n_device)
     print(json.dumps({
         "metric": "assimilation_throughput",
         "value": round(dev_px_s, 1),
         "unit": "pixels/sec",
-        "vs_baseline": round(dev_px_s / base_px_s, 2),
+        "vs_baseline": round(dev_matched_px_s / base_px_s, 2),
+        "n_pix_device": n_device,
+        "n_pix_matched": n_matched,
+        "device_px_s_matched": round(dev_matched_px_s, 1),
     }))
 
 

@@ -24,10 +24,11 @@ selected per geometry — ``obsops.gp``)."""
 from __future__ import annotations
 
 import datetime
+import glob
 import logging
 import os
 import xml.etree.ElementTree as ET
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -46,30 +47,48 @@ BAND_MAP = ["02", "03", "04", "05", "06", "07", "08", "8A", "09", "12"]
 EMULATOR_BAND_MAP = [2, 3, 4, 5, 6, 7, 8, 9, 12, 13]
 
 
+def _zenith_azimuth(node) -> tuple:
+    """(zenith, azimuth) floats of an angle element, None where absent."""
+    z = node.findtext("ZENITH_ANGLE")
+    a = node.findtext("AZIMUTH_ANGLE")
+    return (
+        None if z is None else float(z),
+        None if a is None else float(a),
+    )
+
+
 def parse_s2_xml(filename: str):
-    """Mean solar/viewing angles from a granule metadata file — same
-    structure and averaging as the reference parser
-    (``Sentinel2_Observations.py:23-53``): one Mean_Sun_Angle, the
-    Mean_Viewing_Incidence_Angle_List averaged over bands/detectors."""
-    tree = ET.parse(filename)
-    root = tree.getroot()
-    sza = saa = None
-    vza: List[float] = []
-    vaa: List[float] = []
-    for child in root:
-        for x in child.findall("Tile_Angles"):
-            for y in x.find("Mean_Sun_Angle"):
-                if y.tag == "ZENITH_ANGLE":
-                    sza = float(y.text)
-                elif y.tag == "AZIMUTH_ANGLE":
-                    saa = float(y.text)
-            for s in x.find("Mean_Viewing_Incidence_Angle_List"):
-                for r in s:
-                    if r.tag == "ZENITH_ANGLE":
-                        vza.append(float(r.text))
-                    elif r.tag == "AZIMUTH_ANGLE":
-                        vaa.append(float(r.text))
-    return sza, saa, float(np.mean(vza)), float(np.mean(vaa))
+    """Mean solar/viewing angles ``(sza, saa, vza, vaa)`` from a granule
+    metadata file.
+
+    Semantics match the reference parser (one mean sun angle per scene;
+    viewing angles averaged over all per-band/per-detector entries,
+    ``Sentinel2_Observations.py:23-53``), located here by tag search from
+    the document root rather than by fixed nesting, and validated: a
+    metadata file without a complete sun angle or any viewing-angle entry
+    raises ``ValueError`` naming the file instead of silently returning
+    ``None``/NaN angles that would surface later as opaque failures in aux
+    builders."""
+    root = ET.parse(filename).getroot()
+
+    sun = root.find(".//Mean_Sun_Angle")
+    sza, saa = _zenith_azimuth(sun) if sun is not None else (None, None)
+    if sza is None or saa is None:
+        raise ValueError(
+            f"{filename}: missing or incomplete Mean_Sun_Angle element"
+        )
+
+    pairs = [
+        _zenith_azimuth(el)
+        for el in root.iter("Mean_Viewing_Incidence_Angle")
+    ]
+    vzas = [z for z, _ in pairs if z is not None]
+    vaas = [a for _, a in pairs if a is not None]
+    if not vzas or not vaas:
+        raise ValueError(
+            f"{filename}: no Mean_Viewing_Incidence_Angle entries"
+        )
+    return sza, saa, float(np.mean(vzas)), float(np.mean(vaas))
 
 
 class Sentinel2Observations:
@@ -115,18 +134,33 @@ class Sentinel2Observations:
         self._mapping_cache: Dict[tuple, tuple] = {}
 
     def _find_granules(self) -> None:
-        """Walk for the ``aot.tif`` marker; date from the YYYY/MM/DD path
-        segments (``Sentinel2_Observations.py:116-130``)."""
-        self.dates: List[datetime.datetime] = []
+        """Index granule directories by acquisition date.
+
+        A granule is any directory containing an ``*aot.tif`` marker file
+        under ``<parent>/YYYY/MM/DD/...`` (the marker convention and
+        path-encoded date of the reference data layout,
+        ``Sentinel2_Observations.py:116-130``); discovery here is by glob
+        over that layout.  Directories whose date segments don't parse are
+        skipped with a log message."""
         self.date_data: Dict[datetime.datetime, str] = {}
-        for root, _dirs, files in os.walk(self.parent):
-            for fich in files:
-                if fich.find("aot.tif") >= 0:
-                    parts = root.split(os.sep)[-4:-1]
-                    this_date = datetime.datetime(*[int(i) for i in parts])
-                    self.dates.append(this_date)
-                    self.date_data[this_date] = root
-        self.dates.sort()
+        pattern = os.path.join(
+            glob.escape(self.parent), "*", "*", "*", "*", "*aot.tif"
+        )
+        for marker in glob.glob(pattern):
+            granule_dir = os.path.dirname(marker)
+            day_dir = os.path.dirname(granule_dir)
+            segments = []
+            for _ in range(3):  # day, month, year directories
+                segments.append(os.path.basename(day_dir))
+                day_dir = os.path.dirname(day_dir)
+            try:
+                day, month, year = (int(s) for s in segments)
+                date = datetime.datetime(year, month, day)
+            except ValueError:
+                LOG.warning("skipping non-date granule path %s", granule_dir)
+                continue
+            self.date_data[date] = granule_dir
+        self.dates = sorted(self.date_data)
 
     def define_output(self):
         """(projection, geotransform) of the output grid — the state grid

@@ -121,20 +121,22 @@ SINUSOIDAL = "sinusoidal"
 
 def to_lonlat(crs, x, y):
     """Projected coordinates -> (lon, lat) degrees for a supported CRS."""
-    if crs in (4326, "EPSG:4326", None, ""):
+    key = _crs_key(crs)
+    if key == 4326:
         return np.asarray(x, np.float64), np.asarray(y, np.float64)
-    if crs in (SINUSOIDAL, 6974):
+    if key == SINUSOIDAL:
         return sinusoidal_to_lonlat(x, y)
-    return utm_to_lonlat(x, y, _as_epsg(crs))
+    return utm_to_lonlat(x, y, key)
 
 
 def from_lonlat(crs, lon, lat):
     """(lon, lat) degrees -> projected coordinates for a supported CRS."""
-    if crs in (4326, "EPSG:4326", None, ""):
+    key = _crs_key(crs)
+    if key == 4326:
         return np.asarray(lon, np.float64), np.asarray(lat, np.float64)
-    if crs in (SINUSOIDAL, 6974):
+    if key == SINUSOIDAL:
         return lonlat_to_sinusoidal(lon, lat)
-    return lonlat_to_utm(lon, lat, _as_epsg(crs))
+    return lonlat_to_utm(lon, lat, key)
 
 
 def _as_epsg(crs) -> int:
@@ -142,6 +144,16 @@ def _as_epsg(crs) -> int:
         crs = crs.upper().replace("EPSG:", "")
         return int(crs)
     return int(crs)
+
+
+def _crs_key(crs):
+    """Canonical comparison key for a CRS value, so equivalent spellings
+    (4326 vs 'EPSG:4326' vs None, 'sinusoidal' vs 6974) compare equal."""
+    if crs in (None, "", 4326, "EPSG:4326"):
+        return 4326
+    if crs in (SINUSOIDAL, 6974):
+        return SINUSOIDAL
+    return _as_epsg(crs)
 
 
 def apply_geotransform(gt, col, row):
@@ -177,7 +189,9 @@ def grid_mapping(
     ny, nx = dst_shape
     cols, rows = np.meshgrid(np.arange(nx), np.arange(ny))
     x, y = apply_geotransform(dst_gt, cols, rows)
-    if (src_crs or None) != (dst_crs or None):
+    # Exact equality first: equal-but-unparseable spellings must still be
+    # treated as the identity mapping, without going through _crs_key.
+    if src_crs != dst_crs and _crs_key(src_crs) != _crs_key(dst_crs):
         lon, lat = to_lonlat(dst_crs, x, y)
         x, y = from_lonlat(src_crs, lon, lat)
     return invert_geotransform(src_gt, x, y)

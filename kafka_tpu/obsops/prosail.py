@@ -312,7 +312,10 @@ def inverse_transforms(x):
     """Transformed state -> physical PROSAIL quantities
     (``kafka_test_S2.py:84-92``: cab/car/cm/cw/lai live in exponential
     spaces, ala in [0,1] of 90 deg)."""
-    n = 1.0 + 2.0 * jnp.clip(x[0] - 1.0, 0.0, 1.0)       # plate layers 1..3
+    # Leaf-structure N is carried directly in the state (the reference's
+    # SAILPrior mean is 2.1, ``kafka_test_S2.py:84``) — identity transform,
+    # physical plate-layer range [1, 3].
+    n = jnp.clip(x[0], 1.0, 3.0)
     cab = -100.0 * jnp.log(jnp.clip(x[1], _EPS, 1.0 - _EPS))
     car = -100.0 * jnp.log(jnp.clip(x[2], _EPS, 1.0 - _EPS))
     cbrown = jnp.clip(x[3], 0.0, 1.0)
@@ -333,12 +336,12 @@ class ProsailOperator(ObservationModel):
     n_bands = 10
     n_params = 10
     #: transformed-space domain: exponential-transform params in (0, 1),
-    #: n in [1, 3] (encoded 1..2 pre-transform), ala fraction in (0, 1),
+    #: leaf-structure n carried directly in [1, 3], ala fraction in (0, 1),
     #: bsoil in (0, 2], psoil in (0, 1).
     state_bounds = (
         np.array([1.0, 5e-3, 5e-3, 0.0, 5e-3, 5e-3, 5e-3, 0.02, 0.0, 0.0],
                  np.float32),
-        np.array([2.0, 0.999, 0.999, 1.0, 0.999, 0.999, 0.999, 0.98, 2.0,
+        np.array([3.0, 0.999, 0.999, 1.0, 0.999, 0.999, 0.999, 0.98, 2.0,
                   1.0], np.float32),
     )
 

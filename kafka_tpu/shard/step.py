@@ -33,6 +33,7 @@ def make_sharded_step(
     state_propagator: Optional[Callable] = None,
     use_prior: bool = True,
     solver_options: Optional[dict] = None,
+    n_valid: Optional[int] = None,
 ):
     """Build the jitted, mesh-partitioned per-date step.
 
@@ -45,6 +46,13 @@ def make_sharded_step(
     ``prior_mean`` / ``prior_inv`` are ignored (pass anything) when
     ``use_prior=False``.  ``operator_params`` carries per-date operator data
     (angles, emulator weights) as a traced pytree.
+
+    ``n_valid`` — number of real (unpadded) pixels in the batches this step
+    will see.  With ``pad_for_mesh`` padding, the convergence norm must be
+    normalised by the valid element count, not the padded one, or the
+    tolerance loosens by n_pad/n_valid relative to the reference
+    (``linear_kf.py:296``); same contract as the engine path
+    (``engine/filter.py``).
     """
     opts = dict(solver_options or {})
 
@@ -64,8 +72,13 @@ def make_sharded_step(
             from ..core.linalg import spd_inverse_batched
             p_f_inv = spd_inverse_batched(p_f)
         # --- the multi-band Gauss-Newton solve -------------------------
+        solve_opts = opts
+        if n_valid is not None and "norm_denominator" not in opts:
+            solve_opts = dict(
+                opts, norm_denominator=float(n_valid * x_f.shape[1])
+            )
         x_a, p_inv_a, diags = iterated_solve(
-            linearize, bands, x_f, p_f_inv, operator_params, **opts
+            linearize, bands, x_f, p_f_inv, operator_params, **solve_opts
         )
         return x_a, p_inv_a, diags
 

@@ -143,6 +143,24 @@ class TestCanopyBRF:
         assert abs(cw - 0.015) < 1e-5
         assert abs(ala - 45.0) < 0.05
 
+    def test_leaf_structure_n_is_identity(self):
+        # The reference S2 state carries leaf-structure N directly
+        # (SAILPrior mean 2.1, kafka_test_S2.py:84); the transform must not
+        # remap or saturate it inside the physical range.
+        x = make_state(n=2.1)
+        n = float(inverse_transforms(x)[0])
+        assert abs(n - 2.1) < 1e-6
+
+    def test_sail_prior_mean_strictly_inside_bounds(self):
+        # A prior mean on (or beyond) a bound saturates the clip and zeroes
+        # that parameter's Jacobian, silently making it unidentifiable.
+        from kafka_tpu.engine.priors import sail_prior
+
+        mean = np.asarray(sail_prior().prior.mean)
+        lo, hi = OP.state_bounds
+        assert (mean > lo).all(), (mean, lo)
+        assert (mean < hi).all(), (mean, hi)
+
 
 class TestAssimilation:
     def test_recover_lai_from_reflectance(self):

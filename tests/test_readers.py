@@ -287,3 +287,25 @@ class TestMODIS:
         gather = make_pixel_gather(np.ones((6, 8), bool), pad_multiple=64)
         obs = bhr.get_observations(bhr.dates[0], gather)
         assert np.asarray(obs.bands.y).shape == (2, gather.n_pad)
+
+
+class TestParseS2Xml:
+    def test_missing_sun_angle_raises(self, tmp_path):
+        p = tmp_path / "metadata.xml"
+        p.write_text("<root><Tile_Angles></Tile_Angles></root>")
+        from kafka_tpu.io.sentinel2 import parse_s2_xml
+
+        with pytest.raises(ValueError, match="Mean_Sun_Angle"):
+            parse_s2_xml(str(p))
+
+    def test_missing_viewing_angles_raises(self, tmp_path):
+        p = tmp_path / "metadata.xml"
+        p.write_text(
+            "<root><Tile_Angles><Mean_Sun_Angle>"
+            "<ZENITH_ANGLE>30</ZENITH_ANGLE><AZIMUTH_ANGLE>150</AZIMUTH_ANGLE>"
+            "</Mean_Sun_Angle></Tile_Angles></root>"
+        )
+        from kafka_tpu.io.sentinel2 import parse_s2_xml
+
+        with pytest.raises(ValueError, match="Viewing"):
+            parse_s2_xml(str(p))
