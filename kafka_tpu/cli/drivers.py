@@ -1,0 +1,149 @@
+"""Shared driver harness: a ``RunConfig`` -> chunked assimilation run.
+
+The reference repeats the same per-chunk wiring in each driver script —
+sub-mask, reader, output-with-prefix, prior, ``LinearKalman``, ``run()``
+(``/root/reference/kafka_test_S2.py:135-194``,
+``kafka_test_Py36.py:147-187``).  Here that wiring lives once, driven by
+the declarative ``RunConfig``, and chunk scheduling/restartability comes
+from ``kafka_tpu.shard.run_chunks`` (the dask-equivalent, restart-safe).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..engine import KalmanFilter
+from ..engine.config import RunConfig
+from ..io import GeoTIFFOutput, read_geotiff
+from ..io.tiling import chunk_geotransform, chunk_mask, get_chunks
+from ..shard.scheduler import run_chunks
+
+LOG = logging.getLogger(__name__)
+
+
+def load_state_mask(cfg: RunConfig):
+    """(mask bool array, GeoInfo) from the config's state-mask GeoTIFF."""
+    if cfg.state_mask is None:
+        raise ValueError("RunConfig.state_mask must point to a GeoTIFF")
+    arr, info = read_geotiff(cfg.state_mask)
+    return np.asarray(arr).astype(bool), info.geo
+
+
+def _crs_parts(crs):
+    """Split a reader's ``define_output`` CRS into (projection, epsg)."""
+    if isinstance(crs, int):
+        return "", crs
+    return (crs or ""), None
+
+
+def prosail_aux_builder(metadata, gather):
+    """Scene angles -> ``ProsailAux`` (the per-date geometry the reference
+    feeds through emulator selection, ``Sentinel2_Observations.py:148-159``)."""
+    import jax.numpy as jnp
+
+    from ..obsops.prosail import ProsailAux
+
+    return ProsailAux(
+        sza=jnp.asarray(metadata["sza"], jnp.float32),
+        vza=jnp.asarray(metadata["vza"], jnp.float32),
+        raa=jnp.asarray(metadata["vaa"] - metadata["saa"], jnp.float32),
+    )
+
+
+def run_one_chunk(
+    cfg: RunConfig,
+    chunk,
+    prefix: str,
+    full_mask: np.ndarray,
+    geo,
+    aux_builder: Optional[Callable] = None,
+) -> Optional[dict]:
+    """One chunk's full assimilation: reader, prior, filter, outputs.
+
+    Returns a summary dict, or None when the chunk's mask is empty (the
+    reference's mask-nonempty guard, ``kafka_test_Py36.py:155-157``).
+    """
+    sub_mask = chunk_mask(full_mask, chunk)
+    if not sub_mask.any():
+        return None
+    operator = cfg.make_operator()
+    if cfg.observations == "bhr":
+        obs = cfg.make_observations(operator)
+        obs.apply_roi(
+            chunk.x0, chunk.y0,
+            chunk.x0 + chunk.nx_valid, chunk.y0 + chunk.ny_valid,
+        )
+    else:
+        gt = chunk_geotransform(geo.geotransform, chunk)
+        obs = cfg.make_observations(
+            operator, state_geo=(gt, geo.epsg), aux_builder=aux_builder
+        )
+    crs, out_gt = obs.define_output()
+    projection, epsg = _crs_parts(crs)
+    output = GeoTIFFOutput(
+        cfg.parameter_list, out_gt, projection,
+        folder=cfg.output_folder, prefix=prefix, epsg=epsg,
+        async_writes=True,
+    )
+    prior = cfg.make_prior()
+    kf = KalmanFilter(
+        obs, output, sub_mask, cfg.parameter_list,
+        state_propagation=cfg.make_propagator(),
+        prior=prior,
+        pad_multiple=cfg.pad_multiple,
+        solver_options=cfg.solver_options,
+        hessian_correction=cfg.hessian_correction,
+    )
+    kf.set_trajectory_model()
+    q = cfg.q_diag if cfg.q_diag is not None else np.zeros(cfg.n_params)
+    kf.set_trajectory_uncertainty(np.asarray(q, np.float32))
+    init_prior = cfg.make_initial_prior()
+    if init_prior is None:
+        raise ValueError(
+            "RunConfig needs `prior` or `initial_prior` for the start state"
+        )
+    x0, p_inv0 = init_prior.process_prior(None, kf.gather)
+    t0 = time.time()
+    kf.run(cfg.time_grid(), x0, None, p_inv0)
+    output.close()
+    return {
+        "prefix": prefix,
+        "n_pixels": int(kf.gather.n_valid),
+        "n_dates_assimilated": len(kf.diagnostics_log),
+        "wall_s": round(time.time() - t0, 3),
+    }
+
+
+def run_config(
+    cfg: RunConfig,
+    aux_builder: Optional[Callable] = None,
+    num_processes: Optional[int] = None,
+    process_index: Optional[int] = None,
+) -> dict:
+    """Chunked run over the whole state mask — the ``__main__`` of every
+    reference driver, including the dask fan-out (serial loop and
+    distributed execution are the same code path here;
+    ``kafka_test_S2.py:196-205`` vs ``kafka_test_Py36.py:242-255``)."""
+    full_mask, geo = load_state_mask(cfg)
+    ny, nx = full_mask.shape
+    chunks = list(get_chunks(nx, ny, tuple(cfg.chunk_size)))
+    summaries = []
+
+    def run_one(chunk, prefix):
+        s = run_one_chunk(cfg, chunk, prefix, full_mask, geo, aux_builder)
+        if s is not None:
+            summaries.append(s)
+            LOG.info("chunk %s: %s", prefix, json.dumps(s))
+
+    stats = run_chunks(
+        chunks, run_one, cfg.output_folder,
+        num_processes=num_processes, process_index=process_index,
+    )
+    stats["chunks_with_pixels"] = len(summaries)
+    stats["pixels"] = int(sum(s["n_pixels"] for s in summaries))
+    return stats

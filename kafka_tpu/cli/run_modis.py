@@ -1,0 +1,72 @@
+"""MODIS BHR annual driver — serial information-filter configuration.
+
+TPU-native equivalent of ``/root/reference/kafka_test.py:156-217``:
+7-parameter TIP state, two-stream observation operator over MCD43
+kernel-weight BHR, ``information_filter_lai`` propagation with
+Q[TeLAI]=0.04, JRC prior for the initial state only, 16-day grid over a
+year.  The whole tile runs as one chunk (the reference's serial driver);
+use ``run_modis_distributed`` for the chunked variant.
+
+Usage:
+    python -m kafka_tpu.cli.run_modis --data-folder /path/mcd43 \
+        --state-mask mask.tif --outdir /tmp/kafka_modis
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import logging
+
+from ..engine.config import RunConfig
+from ..engine.priors import TIP_PARAMETER_LIST
+from .drivers import run_config
+
+
+def default_config() -> RunConfig:
+    """The reference's MODIS-annual constants (``kafka_test.py:156-217``)."""
+    return RunConfig(
+        parameter_list=TIP_PARAMETER_LIST,
+        start=datetime.datetime(2017, 1, 1),
+        end=datetime.datetime(2017, 12, 31),
+        step_days=16,
+        operator="twostream",
+        propagator="information_filter_lai",
+        prior=None,
+        initial_prior="jrc",              # kafka_test.py:195-208
+        q_diag=[0, 0, 0, 0, 0, 0, 0.04],  # Q[6::7]=0.04, kafka_test.py:207
+        chunk_size=(2400, 2400),          # whole tile, one chunk
+        observations="bhr",
+        extra={"period": 16},
+    )
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", default=None,
+                    help="RunConfig JSON overriding the annual defaults")
+    ap.add_argument("--data-folder", default=None)
+    ap.add_argument("--state-mask", default=None)
+    ap.add_argument("--outdir", default=None)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING
+    )
+
+    cfg = RunConfig.load(args.config) if args.config else default_config()
+    if args.data_folder:
+        cfg.data_folder = args.data_folder
+    if args.state_mask:
+        cfg.state_mask = args.state_mask
+    if args.outdir:
+        cfg.output_folder = args.outdir
+
+    stats = run_config(cfg)
+    print(json.dumps(stats))
+    return stats
+
+
+if __name__ == "__main__":
+    main()

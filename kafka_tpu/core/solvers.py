@@ -189,6 +189,7 @@ def iterated_solve(
     relaxation: float = 1.0,
     state_bounds: Any = None,
     norm_denominator: Any = None,
+    hessian_forward: Any = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, SolveDiagnostics]:
     """Gauss-Newton relinearisation loop as a single ``lax.while_loop``.
 
@@ -218,6 +219,15 @@ def iterated_solve(
     count (n_valid * p): padding pixels contribute zero step, so dividing by
     the padded size would loosen the tolerance by n_pad/n_valid relative to
     the reference's ``len(x_analysis)`` (``linear_kf.py:296``).
+
+    ``hessian_forward`` — optional per-pixel forward model ``(p,) ->
+    (n_bands,)`` (or ``(operator_params, (p,)) -> (n_bands,)``).  When
+    given, the second-order Hessian correction is subtracted from the
+    returned information matrix after convergence, mirroring the
+    reference's ``P_analysis_inverse - P_correction``
+    (``linear_kf.py:412-416``, ``kf_tools.py:26-72``) with ``jax.hessian``
+    of the forward model in place of the GP emulator's hand-coded
+    ``.hessian``.
 
     Returns ``(x_analysis, p_inv_analysis, diagnostics)``.
     """
@@ -261,6 +271,14 @@ def iterated_solve(
     # (solvers.py:139-142).
     fwd = jnp.einsum("bnp,np->bn", jac, x - x_forecast) + h0
     innovations = jnp.where(obs.mask, obs.y - h0, 0.0)
+
+    if hessian_forward is not None:
+        from .hessian import hessian_correction
+
+        fwd_pixel = _bind_per_pixel(hessian_forward, operator_params)
+        a = a - hessian_correction(
+            fwd_pixel, x, obs.r_inv, innovations, obs.mask
+        )
     diags = SolveDiagnostics(
         innovations=innovations,
         fwd_modelled=fwd,
@@ -295,6 +313,18 @@ def linear_solve(
     return x, a, diags
 
 
+def _bind_per_pixel(fn, operator_params):
+    """Close a ``(params, x_pixel)`` per-pixel forward over its per-date
+    params; 1-argument callables pass through unchanged."""
+    try:
+        n_args = len(inspect.signature(fn).parameters)
+    except (ValueError, TypeError):
+        n_args = 2
+    if n_args >= 2:
+        return lambda x_pixel: fn(operator_params, x_pixel)
+    return fn
+
+
 def _call_linearize(linearize, operator_params, x):
     """Support both ``f(params, x)`` (preferred — per-date data stays a
     traced argument) and plain ``f(x)`` closures (tests, quick scripts)."""
@@ -307,7 +337,7 @@ def _call_linearize(linearize, operator_params, x):
     return linearize(x)
 
 
-@functools.partial(jax.jit, static_argnums=(0,))
+@functools.partial(jax.jit, static_argnums=(0, 6))
 def assimilate_date_jit(
     linearize: LinearizeFn,
     obs: BandBatch,
@@ -315,15 +345,18 @@ def assimilate_date_jit(
     p_inv_forecast: jnp.ndarray,
     operator_params: Any = None,
     solver_options: Any = None,
+    hessian_forward: Any = None,
 ):
     """Jitted entry point for one date's full multi-band assimilation.
 
-    ``linearize`` is a static argument: pass ONE stable callable per
-    observation-operator configuration and feed all per-date data through
-    ``operator_params`` (a traced pytree) — a fresh closure per date would
-    recompile the whole multi-iteration program every timestep.
+    ``linearize`` (and ``hessian_forward``, when used) are static
+    arguments: pass ONE stable callable per observation-operator
+    configuration and feed all per-date data through ``operator_params``
+    (a traced pytree) — a fresh closure per date would recompile the whole
+    multi-iteration program every timestep.
     """
     opts = dict(solver_options or {})
     return iterated_solve(
-        linearize, obs, x_forecast, p_inv_forecast, operator_params, **opts
+        linearize, obs, x_forecast, p_inv_forecast, operator_params,
+        hessian_forward=hessian_forward, **opts
     )

@@ -58,6 +58,18 @@ def _make_prosail(cfg):
     return ProsailOperator()
 
 
+def _named_prior(name: Optional[str]):
+    from .priors import jrc_prior, sail_prior
+
+    if name is None:
+        return None
+    return {
+        "tip": jrc_prior,
+        "jrc": jrc_prior,
+        "sail": sail_prior,
+    }[name]()
+
+
 @dataclasses.dataclass
 class RunConfig:
     """One assimilation run, declaratively.
@@ -76,11 +88,18 @@ class RunConfig:
     operator: str = "identity"
     propagator: str = "none"
     prior: Optional[str] = None
+    #: prior used only for the initial state when ``prior`` is None —
+    #: the MODIS-serial pattern (``kafka_test.py:195-208``: JRCPrior
+    #: provides x0/P0 but the filter advances by propagator alone).
+    initial_prior: Optional[str] = None
     q_diag: Optional[Sequence[float]] = None
     chunk_size: Tuple[int, int] = (128, 128)
     output_folder: str = "."
     data_folder: Optional[str] = None
     state_mask: Optional[str] = None
+    observations: str = "synthetic"
+    pad_multiple: int = 256
+    hessian_correction: bool = False
     solver_options: Optional[dict] = None
     extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
@@ -105,15 +124,43 @@ class RunConfig:
         return PROPAGATORS[self.propagator]
 
     def make_prior(self):
-        from .priors import FixedGaussianPrior, jrc_prior, sail_prior
+        return _named_prior(self.prior)
 
-        if self.prior is None:
-            return None
-        return {
-            "tip": jrc_prior,
-            "jrc": jrc_prior,
-            "sail": sail_prior,
-        }[self.prior]()
+    def make_initial_prior(self):
+        """The prior providing x0/P0^-1: ``initial_prior`` if set, else
+        ``prior``."""
+        return _named_prior(self.initial_prior or self.prior)
+
+    def make_observations(self, operator, state_geo=None, aux_builder=None):
+        """Build the observation source named by ``observations``.
+
+        ``state_geo`` — ``(geotransform, crs)`` of the (chunk) state grid;
+        required by grid-warping readers (sentinel2).  ``aux_builder`` is a
+        runtime callable (not serialisable, so not a config field);
+        serialisable reader knobs live in ``extra`` (``period``,
+        ``relative_uncertainty``).
+        """
+        if self.observations == "sentinel2":
+            from ..io.sentinel2 import Sentinel2Observations
+
+            return Sentinel2Observations(
+                self.data_folder, operator, state_geo,
+                aux_builder=aux_builder,
+                relative_uncertainty=self.extra.get(
+                    "relative_uncertainty", 0.05
+                ),
+            )
+        if self.observations == "bhr":
+            from ..io.modis import BHRObservations
+
+            return BHRObservations(
+                self.data_folder, operator,
+                start_time=self.start, end_time=self.end,
+                period=self.extra.get("period", 16),
+            )
+        raise KeyError(
+            f"no observation-source factory for {self.observations!r}"
+        )
 
     # -- (de)serialisation ------------------------------------------------
 

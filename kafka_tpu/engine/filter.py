@@ -59,6 +59,7 @@ class KalmanFilter:
         pad_multiple: int = 256,
         diagnostics: bool = True,
         solver_options: Optional[dict] = None,
+        hessian_correction: bool = False,
     ):
         self.observations = observations
         self.output = output
@@ -70,6 +71,10 @@ class KalmanFilter:
         # e.g. {"relaxation": 0.7} for damped Gauss-Newton on stiff
         # operators; None reproduces the reference loop exactly.
         self.solver_options = solver_options
+        # Subtract the second-order Hessian correction from the analysis
+        # information matrix (linear_kf.py:412-416) when the operator
+        # exposes a per-pixel forward model.
+        self.hessian_correction = bool(hessian_correction)
         self.diagnostics = diagnostics
         self.diagnostics_log: list = []
         # Identity trajectory model + zero model error by default, matching
@@ -143,9 +148,12 @@ class KalmanFilter:
                 "norm_denominator",
                 float(self.gather.n_valid * self.n_params),
             )
+            hess_fwd = None
+            if self.hessian_correction:
+                hess_fwd = getattr(obs.operator, "forward_pixel", None)
             x_a, p_inv_a, diags = assimilate_date_jit(
                 obs.operator.linearize, obs.bands, x_a,
-                p_inv_a, obs.aux, opts or None,
+                p_inv_a, obs.aux, opts or None, hess_fwd,
             )
             p_a = None
             if self.diagnostics:

@@ -40,3 +40,127 @@ def write_pivot_mask(path: str, ny: int = 204, nx: int = 235,
     mask = make_pivot_mask(ny, nx, n_pivots, seed)
     write_geotiff(path, mask.astype(np.uint8), DEFAULT_GEO)
     return mask
+
+
+_S2_METADATA_XML = """<?xml version="1.0"?>
+<granule><Geometric_Info><Tile_Angles>
+  <Mean_Sun_Angle>
+    <ZENITH_ANGLE>{sza}</ZENITH_ANGLE><AZIMUTH_ANGLE>{saa}</AZIMUTH_ANGLE>
+  </Mean_Sun_Angle>
+  <Mean_Viewing_Incidence_Angle_List>
+    <Mean_Viewing_Incidence_Angle bandId="0">
+      <ZENITH_ANGLE>{vza}</ZENITH_ANGLE><AZIMUTH_ANGLE>{vaa}</AZIMUTH_ANGLE>
+    </Mean_Viewing_Incidence_Angle>
+  </Mean_Viewing_Incidence_Angle_List>
+</Tile_Angles></Geometric_Info></granule>
+"""
+
+
+def make_s2_granule_tree(
+    root: str,
+    dates,
+    truth_state=None,
+    ny: int = 64,
+    nx: int = 64,
+    geo: GeoInfo = DEFAULT_GEO,
+    noise: float = 0.0,
+    seed: int = 0,
+    angles=(30.5, 150.0, 5.0, 100.0),
+):
+    """Write a Sentinel-2 granule tree (``YYYY/MM/DD/granule/``) whose
+    10-band reflectances are the PROSAIL forward model evaluated at
+    ``truth_state`` — physically consistent data for end-to-end driver
+    tests, replacing the private ``/data/nemesis`` trees of the reference
+    (``kafka_test_S2.py:151``).  Returns the truth state used."""
+    import datetime as _dt
+    import os
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..obsops.prosail import ProsailAux, ProsailOperator
+
+    rng = np.random.default_rng(seed)
+    op = ProsailOperator()
+    if truth_state is None:
+        from ..engine.priors import sail_prior
+
+        truth_state = np.asarray(sail_prior().prior.mean).copy()
+        truth_state[6] = np.exp(-3.0 / 2.0)  # LAI 3
+    truth_state = np.asarray(truth_state, np.float32)
+    sza, saa, vza, vaa = angles
+    aux = ProsailAux(
+        sza=jnp.asarray(sza), vza=jnp.asarray(vza),
+        raa=jnp.asarray(vaa - saa),
+    )
+    brf = np.asarray(op.forward(aux, jnp.asarray(truth_state)[None, :]))
+    brf = brf[:, 0]  # (10,)
+    from ..io.sentinel2 import BAND_MAP
+
+    for date in dates:
+        gran = os.path.join(
+            root, f"{date.year}", f"{date.month}", f"{date.day}",
+            "S2_SYNTH_GRANULE",
+        )
+        os.makedirs(gran, exist_ok=True)
+        for bi, b in enumerate(BAND_MAP):
+            field = np.full((ny, nx), brf[bi], np.float32)
+            if noise > 0:
+                field = field + rng.normal(0, noise, field.shape)
+            dn = np.clip(field, 1e-4, 1.0) * 10000.0
+            write_geotiff(
+                os.path.join(gran, f"B{b}_sur.tif"),
+                dn.astype(np.float32), geo,
+            )
+        write_geotiff(
+            os.path.join(gran, "synth_aot.tif"),
+            np.ones((ny, nx), np.float32), geo,
+        )
+        with open(os.path.join(gran, "metadata.xml"), "w") as f:
+            f.write(_S2_METADATA_XML.format(sza=sza, saa=saa, vza=vza,
+                                            vaa=vaa))
+    return truth_state
+
+
+def make_mcd43_series(
+    dirpath: str,
+    dates,
+    truth_state=None,
+    ny: int = 64,
+    nx: int = 64,
+    geo: GeoInfo = DEFAULT_GEO,
+    noise: float = 0.0,
+    seed: int = 0,
+):
+    """Write an MCD43 kernel-weight series whose BHR equals the two-stream
+    forward model at ``truth_state`` (iso weight = albedo, vol/geo zero, so
+    ``kernels . to_BHR`` reproduces it exactly).  Returns the truth state."""
+    import os
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..obsops.twostream import TwoStreamOperator
+
+    rng = np.random.default_rng(seed)
+    op = TwoStreamOperator()
+    if truth_state is None:
+        from ..core.propagators import tip_prior
+
+        truth_state = np.asarray(tip_prior().mean).copy()
+        truth_state[6] = 0.5
+    truth_state = np.asarray(truth_state, np.float32)
+    albedo = np.asarray(
+        op.forward(None, jnp.asarray(truth_state)[None, :])
+    )[:, 0]  # (2,): vis, nir
+    for date in dates:
+        stem = os.path.join(dirpath, f"MCD43_A{date.strftime('%Y%j')}")
+        for bi, band in enumerate(("vis", "nir")):
+            k = np.zeros((ny, nx, 3), np.float32)
+            k[..., 0] = albedo[bi]
+            if noise > 0:
+                k[..., 0] += rng.normal(0, noise, (ny, nx))
+            qa = np.zeros((ny, nx), np.uint8)
+            write_geotiff(f"{stem}_{band}_kernels.tif", k, geo)
+            write_geotiff(f"{stem}_{band}_qa.tif", qa, geo)
+    return truth_state

@@ -217,3 +217,42 @@ class TestCheckpointResume:
         a_full = out_full.output[day(6)]["a"]
         a_res = out_resumed.output[day(6)]["a"]
         np.testing.assert_allclose(a_res, a_full, atol=1e-5)
+
+
+class TestHessianCorrectionWiring:
+    def test_correction_changes_information_not_state(self):
+        """hessian_correction=True must flow through the engine to the
+        solver (linear_kf.py:412-416 semantics): identical analysis state,
+        different posterior information for a nonlinear operator."""
+        from kafka_tpu.core import tip_prior
+        from kafka_tpu.engine.priors import TIP_PARAMETER_LIST
+
+        mask = circle_mask(8, 8, 3)
+        op = TwoStreamOperator()
+        base = np.asarray(tip_prior().mean)
+        truth = np.broadcast_to(base, mask.shape + (7,)).copy()
+        truth[..., 6] = 0.5
+        prior = FixedGaussianPrior(tip_prior(), TIP_PARAMETER_LIST)
+
+        def build(hessian_correction):
+            obs = SyntheticObservations(
+                dates=[day(1)], operator=op,
+                truth_fn=lambda date: truth, sigma=0.01, mask_prob=0.0,
+                seed=5,
+            )
+            out = MemoryOutput()
+            kf = KalmanFilter(
+                obs, out, mask, TIP_PARAMETER_LIST,
+                state_propagation=None, prior=prior, pad_multiple=64,
+                hessian_correction=hessian_correction,
+            )
+            x0, p_inv0 = prior.process_prior(None, kf.gather)
+            x_a, _, p_inv_a = kf.run([day(0), day(2)], x0, None, p_inv0)
+            return np.asarray(x_a), np.asarray(p_inv_a)
+
+        x_plain, p_inv_plain = build(False)
+        x_corr, p_inv_corr = build(True)
+        np.testing.assert_allclose(x_corr, x_plain, atol=1e-6)
+        assert np.isfinite(p_inv_corr).all()
+        # Nonlinear operator + nonzero innovations -> a real correction.
+        assert np.abs(p_inv_corr - p_inv_plain).max() > 1e-6
