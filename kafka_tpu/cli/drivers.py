@@ -72,16 +72,17 @@ def run_one_chunk(
     if not sub_mask.any():
         return None
     operator = cfg.make_operator()
-    if cfg.observations == "bhr":
-        obs = cfg.make_observations(operator)
+    gt = chunk_geotransform(geo.geotransform, chunk)
+    obs = cfg.make_observations(
+        operator, state_geo=(gt, geo.epsg), aux_builder=aux_builder
+    )
+    if hasattr(obs, "apply_roi"):
+        # Native-grid reader (MODIS family): window to the chunk instead of
+        # warping — the reference's per-chunk apply_roi
+        # (``kafka_test_Py36.py:162``).
         obs.apply_roi(
             chunk.x0, chunk.y0,
             chunk.x0 + chunk.nx_valid, chunk.y0 + chunk.ny_valid,
-        )
-    else:
-        gt = chunk_geotransform(geo.geotransform, chunk)
-        obs = cfg.make_observations(
-            operator, state_geo=(gt, geo.epsg), aux_builder=aux_builder
         )
     crs, out_gt = obs.define_output()
     projection, epsg = _crs_parts(crs)

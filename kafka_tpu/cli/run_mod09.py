@@ -1,0 +1,72 @@
+"""MOD09 directional-reflectance driver — kernel-weight retrieval.
+
+The observation path the reference sketches but never wires into a driver
+(``/root/reference/kafka/input_output/observations.py:89-147``): MOD09GA
+clear-sky directional reflectances assimilated into a per-pixel, per-band
+Ross-Li kernel-weight state (21 parameters) with the linear
+``KernelsOperator`` — the MCD43 kernel inversion recast as a temporal
+filter.  Information-filter propagation accumulates angular sampling
+across dates (the temporal replacement for MCD43's 16-day window fit);
+the weak kernel prior seeds the initial state only.
+
+Usage:
+    python -m kafka_tpu.cli.run_mod09 --data-folder /path/mod09 \
+        --state-mask mask.tif --outdir /tmp/kafka_mod09
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import logging
+
+from ..engine.config import RunConfig
+from ..engine.priors import KERNEL_PARAMETER_LIST
+from .drivers import run_config
+
+
+def default_config() -> RunConfig:
+    return RunConfig(
+        parameter_list=KERNEL_PARAMETER_LIST,
+        start=datetime.datetime(2017, 6, 1),
+        end=datetime.datetime(2017, 6, 30),
+        step_days=1,
+        operator="kernels",
+        propagator="information_filter",
+        prior=None,
+        initial_prior="kernels",
+        q_diag=[0.0] * 21,
+        chunk_size=(256, 256),    # kafka_test_Py36.py:241 chunking
+        observations="mod09",
+    )
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", default=None,
+                    help="RunConfig JSON overriding the defaults")
+    ap.add_argument("--data-folder", default=None)
+    ap.add_argument("--state-mask", default=None)
+    ap.add_argument("--outdir", default=None)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING
+    )
+
+    cfg = RunConfig.load(args.config) if args.config else default_config()
+    if args.data_folder:
+        cfg.data_folder = args.data_folder
+    if args.state_mask:
+        cfg.state_mask = args.state_mask
+    if args.outdir:
+        cfg.output_folder = args.outdir
+
+    stats = run_config(cfg)
+    print(json.dumps(stats))
+    return stats
+
+
+if __name__ == "__main__":
+    main()

@@ -49,7 +49,20 @@ def _operator_registry() -> Dict[str, Callable]:
         "twostream": lambda cfg: TwoStreamOperator(),
         "wcm": lambda cfg: WCMOperator(),
         "prosail": lambda cfg: _make_prosail(cfg),
+        "kernels": lambda cfg: _make_kernels(cfg),
     }
+
+
+def _make_kernels(cfg):
+    from ..obsops.kernels import KernelsOperator
+
+    n_bands, rem = divmod(cfg.n_params, 3)
+    if rem:
+        raise ValueError(
+            "the kernels operator needs 3 weights per band; "
+            f"parameter_list has {cfg.n_params} entries"
+        )
+    return KernelsOperator(n_modis_bands=n_bands)
 
 
 def _make_prosail(cfg):
@@ -58,11 +71,23 @@ def _make_prosail(cfg):
     return ProsailOperator()
 
 
-def _named_prior(name: Optional[str]):
-    from .priors import jrc_prior, sail_prior
+def _named_prior(name: Optional[str], cfg: Optional["RunConfig"] = None):
+    from .priors import jrc_prior, kernels_prior, sail_prior
 
     if name is None:
         return None
+    if name == "kernels":
+        # Band count follows the state size so non-7-band kernel configs
+        # get a matching prior, like _make_kernels does for the operator.
+        if cfg is None:
+            return kernels_prior()
+        n_bands, rem = divmod(cfg.n_params, 3)
+        if rem:
+            raise ValueError(
+                "the kernels prior needs 3 weights per band; "
+                f"parameter_list has {cfg.n_params} entries"
+            )
+        return kernels_prior(n_modis_bands=n_bands)
     return {
         "tip": jrc_prior,
         "jrc": jrc_prior,
@@ -124,12 +149,12 @@ class RunConfig:
         return PROPAGATORS[self.propagator]
 
     def make_prior(self):
-        return _named_prior(self.prior)
+        return _named_prior(self.prior, self)
 
     def make_initial_prior(self):
         """The prior providing x0/P0^-1: ``initial_prior`` if set, else
         ``prior``."""
-        return _named_prior(self.initial_prior or self.prior)
+        return _named_prior(self.initial_prior or self.prior, self)
 
     def make_observations(self, operator, state_geo=None, aux_builder=None):
         """Build the observation source named by ``observations``.
@@ -157,6 +182,20 @@ class RunConfig:
                 self.data_folder, operator,
                 start_time=self.start, end_time=self.end,
                 period=self.extra.get("period", 16),
+            )
+        if self.observations == "mod09":
+            from ..io.mod09 import MOD09Observations
+
+            return MOD09Observations(
+                self.data_folder, operator,
+                start_time=self.start, end_time=self.end,
+            )
+        if self.observations == "synergy":
+            from ..io.modis import SynergyKernels
+
+            return SynergyKernels(
+                self.data_folder, operator,
+                start_time=self.start, end_time=self.end,
             )
         raise KeyError(
             f"no observation-source factory for {self.observations!r}"

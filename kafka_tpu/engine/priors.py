@@ -29,6 +29,18 @@ TIP_PARAMETER_LIST = (
     "w_vis", "x_vis", "a_vis", "w_nir", "x_nir", "a_nir", "TeLAI",
 )
 
+def kernel_parameter_list(n_modis_bands: int) -> Tuple[str, ...]:
+    """Kernel-weight parameter names: (iso, vol, geo) per MODIS band."""
+    return tuple(
+        f"b{b + 1}_{k}"
+        for b in range(n_modis_bands)
+        for k in ("iso", "vol", "geo")
+    )
+
+
+# The 21-parameter kernel-weight state of the MOD09 path.
+KERNEL_PARAMETER_LIST = kernel_parameter_list(7)
+
 
 class FixedGaussianPrior:
     """A time-invariant i.i.d.-per-pixel Gaussian prior."""
@@ -62,6 +74,24 @@ def sail_prior() -> FixedGaussianPrior:
         inv_cov=jnp.asarray(inv_cov),
     )
     return FixedGaussianPrior(prior, PROSAIL_PARAMETER_LIST)
+
+
+def kernels_prior(n_modis_bands: int = 7,
+                  sigma: float = 0.2) -> FixedGaussianPrior:
+    """A weak prior for the MOD09 kernel-weight state: plausible land-band
+    magnitudes (moderate isotropic, smaller volumetric/geometric) with a
+    broad diagonal covariance, so the retrieval is observation-driven the
+    way the reference's MCD43-style inversion is."""
+    mean = np.tile(
+        np.array([0.15, 0.05, 0.02], np.float32), n_modis_bands
+    )
+    sig = np.full(3 * n_modis_bands, sigma, np.float32)
+    prior = PixelPrior(
+        mean=jnp.asarray(mean),
+        cov=jnp.asarray(np.diag(sig**2), jnp.float32),
+        inv_cov=jnp.asarray(np.diag(1.0 / sig**2), jnp.float32),
+    )
+    return FixedGaussianPrior(prior, kernel_parameter_list(n_modis_bands))
 
 
 def jrc_prior() -> FixedGaussianPrior:

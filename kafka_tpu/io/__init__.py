@@ -2,7 +2,8 @@
 writers, chunk tiling."""
 
 from .geotiff import GeoInfo, TiffInfo, read_geotiff, read_info, write_geotiff
-from .modis import BHRObservations
+from .mod09 import MOD09Observations, decode_state_qa, zoom2_nearest
+from .modis import BHRObservations, SynergyKernels
 from .output import GeoTIFFOutput
 from .sentinel1 import S1Observations
 from .sentinel2 import (

@@ -40,6 +40,7 @@ from ..core.types import BandBatch
 from ..engine.protocols import DateObservation
 from ..engine.state import PixelGather
 from .geotiff import read_geotiff
+from .roi import RoiWindowMixin, index_dated_paths
 
 LOG = logging.getLogger(__name__)
 
@@ -48,8 +49,15 @@ TO_BHR = np.array([1.0, 0.189184, -1.377622], np.float64)
 BAND_TRANSFER = {0: "vis", 1: "nir"}  # observations.py:254-255
 _FNAME_RE = re.compile(r"MCD43_A(\d{7})_(vis|nir)_kernels\.tif$")
 
+#: MODIS narrowband -> broadband albedo integration (the published spectral
+#: conversion the reference hard-codes in ``SynergyKernels.get_band_data``,
+#: ``observations.py:187-192``): weights over land bands 1-7 plus intercept.
+TO_VIS = np.array([0.3265, 0.0, 0.4364, 0.2366, 0.0, 0.0, 0.0], np.float64)
+TO_NIR = np.array([0.0, 0.5447, 0.0, 0.0, 0.1363, 0.0469, 0.2536], np.float64)
+BB_INTERCEPT = (-0.0019, -0.0068)  # (VIS, NIR)
 
-class BHRObservations:
+
+class BHRObservations(RoiWindowMixin):
     """ObservationSource over preprocessed MCD43 kernel-weight GeoTIFFs."""
 
     def __init__(
@@ -68,7 +76,6 @@ class BHRObservations:
         # Thin to one date per `period` days (observations.py:241-242).
         self.dates = self.dates[::period] if period > 1 else self.dates
         self.bands_per_observation = {d: 2 for d in self.dates}
-        self.roi = None
 
     def _index_granules(self, start_time, end_time) -> None:
         dates = set()
@@ -86,17 +93,6 @@ class BHRObservations:
             dates.add(d)
         self.dates: List[datetime.datetime] = sorted(dates)
 
-    def apply_roi(self, ulx: int, uly: int, lrx: int, lry: int) -> None:
-        """Pixel-window ROI, the chunked-driver hook
-        (``observations.py:262-267``, ``kafka_test_Py36.py:162``)."""
-        self.roi = (ulx, uly, lrx, lry)
-
-    def _window(self, arr: np.ndarray) -> np.ndarray:
-        if self.roi is None:
-            return arr
-        ulx, uly, lrx, lry = self.roi
-        return arr[uly:lry, ulx:lrx]
-
     def _paths(self, date: datetime.datetime, band: int):
         stem = f"MCD43_A{date.strftime('%Y%j')}_{BAND_TRANSFER[band]}"
         return (
@@ -105,13 +101,10 @@ class BHRObservations:
         )
 
     def define_output(self):
+        self._require_dates()
         kpath, _ = self._paths(self.dates[0], 0)
         _, info = read_geotiff(kpath)
-        gt = list(info.geo.geotransform)
-        if self.roi is not None:
-            ulx, uly = self.roi[0], self.roi[1]
-            gt[0] += ulx * gt[1]
-            gt[3] += uly * gt[5]
+        gt = self._shift_geotransform(info.geo.geotransform)
         return info.geo.epsg or "sinusoidal", gt
 
     def get_observations(self, date, gather: PixelGather) -> DateObservation:
@@ -148,3 +141,99 @@ class BHRObservations:
             operator=self.operator,
             aux=self.aux_builder(date, gather),
         )
+
+
+_SYNERGY_RE = re.compile(r"\.A(\d{7})")
+
+
+class SynergyKernels(RoiWindowMixin):
+    """Broadband-albedo observations from per-band kernel-weight series.
+
+    The reference's ``SynergyKernels`` (``observations.py:150-211``) indexes
+    ``*_b{band}_kernel_weights.tif`` time series, integrates the 3 kernel
+    weights to white-sky albedo with ``to_BHR`` and spectrally integrates
+    the 7 MODIS land bands to broadband VIS/NIR — but its ``get_band_data``
+    never returns and never touches uncertainty.  This class completes the
+    contract: 2-band (VIS, NIR) broadband BHR observations with variance
+    propagated through both linear integrations, assuming independent
+    per-kernel, per-band errors:
+
+        var(BHR_b) = sum_k to_BHR[k]^2 * sigma_bk^2
+        var(BB)    = sum_b w_b^2 * var(BHR_b)
+
+    On-disk contract per date (3-band float GeoTIFFs, kernel order
+    iso/vol/geo, matching the reference's file naming ``:155-170``):
+
+        <dir>/<stem>.A<%Y%j>_b{0..6}_kernel_weights.tif
+        <dir>/<stem>.A<%Y%j>_b{0..6}_kernel_unc.tif
+        <dir>/<stem>.A<%Y%j>_mask.tif                (uint8, 1 = usable)
+    """
+
+    def __init__(
+        self,
+        data_dir: str,
+        operator: Any,
+        start_time: Optional[datetime.datetime] = None,
+        end_time: Optional[datetime.datetime] = None,
+    ):
+        self.data_dir = data_dir
+        self.operator = operator
+        self._stems: Dict[datetime.datetime, str] = index_dated_paths(
+            os.path.join(data_dir, "*_b0_kernel_weights.tif"), _SYNERGY_RE,
+            start_time, end_time,
+            transform=lambda p: p[: -len("_b0_kernel_weights.tif")],
+            label="Synergy series",
+        )
+        self.dates: List[datetime.datetime] = sorted(self._stems)
+        self.bands_per_observation = {d: 2 for d in self.dates}
+
+    def add_observations(self, date: datetime.datetime, stem: str) -> None:
+        """Append one date to the index (``observations.py:176-182``)."""
+        self._stems[date] = stem
+        self.dates = sorted(self._stems)
+        self.bands_per_observation[date] = 2
+
+    def define_output(self):
+        self._require_dates()
+        stem = self._stems[self.dates[0]]
+        _, info = read_geotiff(stem + "_b0_kernel_weights.tif")
+        gt = self._shift_geotransform(info.geo.geotransform)
+        return info.geo.epsg or info.geo.projection or "sinusoidal", gt
+
+    def get_observations(self, date, gather: PixelGather) -> DateObservation:
+        stem = self._stems[date]
+        mask_r, _ = read_geotiff(stem + "_mask.tif")
+        usable = gather.gather(
+            self._window(np.asarray(mask_r).squeeze().astype(bool))
+        ) & gather.valid
+
+        bhr = np.zeros((7, gather.n_pad), np.float64)
+        var = np.zeros((7, gather.n_pad), np.float64)
+        for band in range(7):
+            k, _ = read_geotiff(f"{stem}_b{band}_kernel_weights.tif")
+            u, _ = read_geotiff(f"{stem}_b{band}_kernel_unc.tif")
+            k_pix = gather.gather(
+                self._window(np.asarray(k, np.float64))
+            )  # (n_pad, 3)
+            u_pix = gather.gather(self._window(np.asarray(u, np.float64)))
+            bhr[band] = k_pix @ TO_BHR
+            var[band] = (u_pix**2) @ (TO_BHR**2)
+
+        ys, r_invs, masks = [], [], []
+        for bb, weights in enumerate((TO_VIS, TO_NIR)):
+            y = weights @ bhr + BB_INTERCEPT[bb]
+            v = (weights**2) @ var
+            valid = usable & np.isfinite(y) & (v > 0)
+            ys.append(np.where(valid, y, 0.0).astype(np.float32))
+            with np.errstate(divide="ignore"):
+                r_invs.append(
+                    np.where(valid, 1.0 / v, 0.0).astype(np.float32)
+                )
+            masks.append(valid)
+
+        bands = BandBatch(
+            y=jnp.asarray(np.stack(ys)),
+            r_inv=jnp.asarray(np.stack(r_invs)),
+            mask=jnp.asarray(np.stack(masks)),
+        )
+        return DateObservation(bands=bands, operator=self.operator, aux=None)

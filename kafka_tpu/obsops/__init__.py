@@ -11,6 +11,13 @@ from .twostream import (
     tlai_to_lai,
     twostream_albedo,
 )
+from .kernels import (
+    KernelsAux,
+    KernelsOperator,
+    li_sparse_reciprocal,
+    ross_li_kernels,
+    ross_thick,
+)
 from .gp import (
     GPBankOperator,
     GPParams,

@@ -122,6 +122,112 @@ def make_s2_granule_tree(
     return truth_state
 
 
+def make_mod09_granules(
+    dirpath: str,
+    dates,
+    truth_weights=None,
+    ny: int = 32,
+    nx: int = 32,
+    geo: GeoInfo = DEFAULT_GEO,
+    noise: float = 0.0,
+    seed: int = 0,
+    angles=None,
+):
+    """Write MOD09GA-style granule directories whose 7-band reflectances
+    are the Ross-Li kernel model evaluated at ``truth_weights`` under each
+    date's geometry — the physically consistent stand-in for real HDF4
+    granules (``/root/reference/kafka/input_output/observations.py:89-147``).
+
+    ``ny, nx`` is the 1 km grid; reflectance rasters are written at the
+    2x 500 m resolution.  ``angles`` maps each date to
+    ``(sza, saa, vza, vaa)`` degrees (a default sweep is used when None).
+    Returns the ``(21,)`` truth kernel-weight state.
+    """
+    import os
+
+    import numpy as np
+
+    from ..obsops.kernels import ross_li_kernels
+
+    rng = np.random.default_rng(seed)
+    if truth_weights is None:
+        # Plausible MODIS land-band weights: moderate iso, smaller vol/geo.
+        iso = np.array([0.05, 0.3, 0.04, 0.06, 0.25, 0.2, 0.1])
+        truth_weights = np.stack(
+            [iso, 0.4 * iso, 0.15 * iso], axis=1
+        ).reshape(-1)
+    truth_weights = np.asarray(truth_weights, np.float32)
+    w = truth_weights.reshape(7, 3)
+    for di, date in enumerate(dates):
+        if angles is not None:
+            sza, saa, vza, vaa = angles[di]
+        else:  # sweep geometry so the kernel weights are identifiable
+            sza, saa = 25.0 + 3.0 * di, 140.0
+            vza, vaa = 10.0 + 5.0 * (di % 4), 140.0 + 30.0 * (di % 3)
+        gran = os.path.join(dirpath, f"MOD09GA.A{date.strftime('%Y%j')}")
+        os.makedirs(gran, exist_ok=True)
+        k_vol, k_geo = ross_li_kernels(sza, vza, vaa - saa)
+        k_vol, k_geo = float(k_vol), float(k_geo)
+        for band in range(7):
+            refl = w[band, 0] + k_vol * w[band, 1] + k_geo * w[band, 2]
+            field = np.full((2 * ny, 2 * nx), refl, np.float32)
+            if noise > 0:
+                field = field + rng.normal(0, noise, field.shape)
+            write_geotiff(
+                os.path.join(gran, f"sur_refl_b{band + 1:02d}.tif"),
+                np.clip(field * 10000.0, 1.0, 16000.0).astype(np.int16),
+                geo,
+            )
+        write_geotiff(  # QA word 8 = clear sky, no shadow, land
+            os.path.join(gran, "state_1km.tif"),
+            np.full((ny, nx), 8, np.uint16), geo,
+        )
+        for name, deg in (
+            ("SolarZenith_1", sza), ("SolarAzimuth_1", saa),
+            ("SensorZenith_1", vza), ("SensorAzimuth_1", vaa),
+        ):
+            write_geotiff(
+                os.path.join(gran, name + ".tif"),
+                np.full((ny, nx), round(deg * 100), np.int16), geo,
+            )
+    return truth_weights
+
+
+def make_synergy_series(
+    dirpath: str,
+    dates,
+    truth_bhr=None,
+    ny: int = 32,
+    nx: int = 32,
+    geo: GeoInfo = DEFAULT_GEO,
+    kernel_unc: float = 0.005,
+    stem: str = "SYN.h17v05",
+):
+    """Write a Synergy kernel-weight series (per-band weights + unc + mask
+    GeoTIFFs, the ``observations.py:150-170`` file layout) whose per-band
+    white-sky albedo equals ``truth_bhr`` (7,).  Returns ``truth_bhr``."""
+    import os
+
+    import numpy as np
+
+    if truth_bhr is None:
+        truth_bhr = np.array([0.05, 0.3, 0.04, 0.06, 0.25, 0.2, 0.1])
+    truth_bhr = np.asarray(truth_bhr, np.float64)
+    os.makedirs(dirpath, exist_ok=True)
+    for date in dates:
+        base = os.path.join(dirpath, f"{stem}.A{date.strftime('%Y%j')}")
+        for band in range(7):
+            k = np.zeros((ny, nx, 3), np.float32)
+            k[..., 0] = truth_bhr[band]  # iso-only => kernels . to_BHR = iso
+            u = np.full((ny, nx, 3), kernel_unc, np.float32)
+            write_geotiff(f"{base}_b{band}_kernel_weights.tif", k, geo)
+            write_geotiff(f"{base}_b{band}_kernel_unc.tif", u, geo)
+        write_geotiff(
+            f"{base}_mask.tif", np.ones((ny, nx), np.uint8), geo
+        )
+    return truth_bhr
+
+
 def make_mcd43_series(
     dirpath: str,
     dates,
