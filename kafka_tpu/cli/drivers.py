@@ -99,6 +99,7 @@ def run_one_chunk(
         pad_multiple=cfg.pad_multiple,
         solver_options=cfg.solver_options,
         hessian_correction=cfg.hessian_correction,
+        prefetch_depth=cfg.prefetch_depth,
     )
     kf.set_trajectory_model()
     q = cfg.q_diag if cfg.q_diag is not None else np.zeros(cfg.n_params)

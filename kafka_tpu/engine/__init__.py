@@ -2,11 +2,14 @@
 
 from .checkpoint import Checkpointer
 from .filter import KalmanFilter
+from .prefetch import ObservationPrefetcher, planned_observation_dates
 from .priors import (
+    KERNEL_PARAMETER_LIST,
     PROSAIL_PARAMETER_LIST,
     TIP_PARAMETER_LIST,
     FixedGaussianPrior,
     jrc_prior,
+    kernels_prior,
     sail_prior,
 )
 from .protocols import DateObservation, ObservationSource, OutputWriter, Prior

@@ -125,6 +125,8 @@ class RunConfig:
     observations: str = "synthetic"
     pad_multiple: int = 256
     hessian_correction: bool = False
+    #: double-buffered observation prefetch depth; 0 = synchronous reads
+    prefetch_depth: int = 2
     solver_options: Optional[dict] = None
     extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
 

@@ -27,6 +27,7 @@ from ..core.linalg import spd_inverse_batched
 from ..core.solvers import assimilate_date_jit
 from ..core.time_grid import iterate_time_grid
 from ..core.types import BandBatch
+from .prefetch import ObservationPrefetcher, planned_observation_dates
 from .protocols import DateObservation, ObservationSource, OutputWriter, Prior
 from .state import PixelGather, make_pixel_gather
 
@@ -60,6 +61,7 @@ class KalmanFilter:
         diagnostics: bool = True,
         solver_options: Optional[dict] = None,
         hessian_correction: bool = False,
+        prefetch_depth: int = 2,
     ):
         self.observations = observations
         self.output = output
@@ -75,6 +77,11 @@ class KalmanFilter:
         # information matrix (linear_kf.py:412-416) when the operator
         # exposes a per-pixel forward model.
         self.hessian_correction = bool(hessian_correction)
+        # Depth of the double-buffered observation prefetch (SURVEY §2.2
+        # raster row); 0 reads synchronously in the loop like the reference
+        # (linear_kf.py:225-227).
+        self.prefetch_depth = int(prefetch_depth)
+        self._prefetcher = None
         self.diagnostics = diagnostics
         self.diagnostics_log: list = []
         # Identity trajectory model + zero model error by default, matching
@@ -134,7 +141,10 @@ class KalmanFilter:
             # P^-1; the solver works in information space.
             p_inv_a = spd_inverse_batched(jnp.asarray(p_a, jnp.float32))
         for date in dates:
-            obs = self.observations.get_observations(date, self.gather)
+            if self._prefetcher is not None:
+                obs = self._prefetcher.get(date)
+            else:
+                obs = self.observations.get_observations(date, self.gather)
             t0 = time.time()
             opts = dict(self.solver_options or {})
             if "state_bounds" not in opts and \
@@ -188,6 +198,27 @@ class KalmanFilter:
             p_forecast_inverse = jnp.asarray(
                 p_forecast_inverse, jnp.float32
             )
+        if self.prefetch_depth > 0:
+            plan = planned_observation_dates(
+                time_grid, self.observations.dates
+            )
+            if plan:
+                self._prefetcher = ObservationPrefetcher(
+                    self.observations, self.gather, plan,
+                    depth=self.prefetch_depth,
+                )
+        try:
+            return self._run_loop(
+                time_grid, x_forecast, p_forecast, p_forecast_inverse,
+                checkpointer, advance_first,
+            )
+        finally:
+            if self._prefetcher is not None:
+                self._prefetcher.close()
+                self._prefetcher = None
+
+    def _run_loop(self, time_grid, x_forecast, p_forecast,
+                  p_forecast_inverse, checkpointer, advance_first):
         x_analysis, p_analysis, p_analysis_inverse = (
             x_forecast, p_forecast, p_forecast_inverse
         )

@@ -1,0 +1,117 @@
+"""Double-buffered observation prefetch.
+
+SURVEY.md §2.2 (raster row) requires the input pipeline to feed fixed-shape
+pixel blocks into device HBM ahead of the solve, the way the output side
+already hides GeoTIFF encoding behind ``GeoTIFFOutput``'s writer thread.
+The reference reads every band synchronously inside the time loop
+(``/root/reference/kafka/linear_kf.py:225-227`` — per band *and* per date,
+GDAL warp on the critical path); here a single worker thread walks the
+run's observation dates in order, performs the full host-side read/decode/
+warp/gather for date t+1 (including the ``jnp.asarray`` device upload the
+readers already do), and parks the result in a bounded queue while the
+device solves date t.
+
+The assimilation order is fully known before the loop starts (the time
+grid windows the observation dates deterministically), so prefetching is a
+straight pipeline, not speculation.  Queue depth 2 = classic double
+buffering; the worker blocks when the buffer is full, bounding host memory
+at ``depth`` gathered dates.
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import queue
+import threading
+from typing import List, Optional, Sequence
+
+from .protocols import DateObservation, ObservationSource
+from .state import PixelGather
+
+LOG = logging.getLogger(__name__)
+
+_SENTINEL_ERROR = object()
+
+
+class ObservationPrefetcher:
+    """Reads ``dates`` from ``source`` on a worker thread, in order.
+
+    ``get(date)`` returns the prefetched ``DateObservation`` for the next
+    date in sequence — callers must consume dates in the order given
+    (the filter's time loop does).  Worker exceptions re-raise in the
+    caller at the ``get`` for the failing date.
+    """
+
+    def __init__(
+        self,
+        source: ObservationSource,
+        gather: PixelGather,
+        dates: Sequence[datetime.datetime],
+        depth: int = 2,
+    ):
+        self._source = source
+        self._gather = gather
+        self._dates: List[datetime.datetime] = list(dates)
+        self._queue: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._worker, name="obs-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def _worker(self) -> None:
+        for date in self._dates:
+            if self._stopped.is_set():
+                return
+            try:
+                obs = self._source.get_observations(date, self._gather)
+            except BaseException as exc:  # re-raised at the caller's get()
+                self._queue.put((_SENTINEL_ERROR, exc))
+                return
+            self._queue.put((date, obs))
+
+    def get(self, date: datetime.datetime) -> DateObservation:
+        got, obs = self._queue.get()
+        if got is _SENTINEL_ERROR:
+            raise obs
+        if got != date:
+            # Out-of-order consumption would silently assimilate the wrong
+            # acquisition; fail loudly instead.
+            raise RuntimeError(
+                f"prefetch order violation: requested {date}, queued {got}"
+            )
+        return obs
+
+    def close(self) -> None:
+        """Stop the worker; safe to call at any point (e.g. early abort)."""
+        self._stopped.set()
+        # Unblock a worker waiting on a full queue.
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            # A read longer than the join timeout is still in flight; it
+            # holds file handles / host memory until it finishes.
+            LOG.warning(
+                "observation prefetch worker still running after close() "
+                "(a read is in flight); it will exit after the current date"
+            )
+
+
+def planned_observation_dates(
+    time_grid, observation_dates
+) -> List[datetime.datetime]:
+    """The exact, ordered sequence of acquisition dates ``KalmanFilter.run``
+    will assimilate for this grid — the prefetcher's work list."""
+    from ..core.time_grid import iterate_time_grid
+
+    out: List[datetime.datetime] = []
+    for _, locate_times, _ in iterate_time_grid(
+        time_grid, observation_dates, verbose=False
+    ):
+        out.extend(locate_times)
+    return out

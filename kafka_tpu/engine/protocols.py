@@ -40,7 +40,15 @@ class ObservationSource(Protocol):
 
     ``dates`` lists available acquisitions (reference: ``.dates``,
     ``observations.py:241-249``); ``get_observations`` gathers one date's
-    rasters into the fixed pixel batch."""
+    rasters into the fixed pixel batch.
+
+    Threading contract: the filter prefetches observations on a background
+    thread by default (``KalmanFilter(prefetch_depth=2)``), so
+    ``get_observations`` must be safe to call off the main thread and must
+    not mutate state shared with the ``Prior`` or ``OutputWriter`` without
+    its own locking.  All in-repo sources are pure reads and comply; a
+    source that cannot meet this should be run with ``prefetch_depth=0``
+    (synchronous reads, the reference's behaviour)."""
 
     @property
     def dates(self) -> Sequence[datetime.datetime]: ...

@@ -1,0 +1,109 @@
+"""Multi-process worker for distributed-backend tests.
+
+Run as ``python -m kafka_tpu.testing.multiprocess_worker`` in N coordinated
+processes.  Exercises the real multi-host bring-up path end to end — the
+thing the reference only ever does against a live dask scheduler
+(``/root/reference/kafka_test_Py36.py:249-255``) and which round 1 only
+faked with a patched ``process_index``:
+
+1. ``jax.distributed.initialize`` against a localhost coordinator
+   (``shard.mesh.initialize_distributed``);
+2. a global device mesh spanning both processes with a real cross-process
+   collective (``psum`` of per-shard sums must equal the global sum);
+3. ``shard.scheduler.run_chunks`` with the true ``jax.process_index()``,
+   writing per-chunk outputs + ``.done`` markers into a shared directory.
+
+Each process writes ``result_<pid>.json`` with everything the parent test
+asserts on.  Exit code 0 only if all local checks pass.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", required=True)  # host:port
+    ap.add_argument("--num-processes", type=int, required=True)
+    ap.add_argument("--process-id", type=int, required=True)
+    ap.add_argument("--outdir", required=True)
+    ap.add_argument("--devices-per-process", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    # Platform must be pinned before JAX initialises.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count="
+        f"{args.devices_per_process}"
+    ).strip()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kafka_tpu.io.tiling import get_chunks
+    from kafka_tpu.shard.mesh import initialize_distributed, make_pixel_mesh
+    from kafka_tpu.shard.scheduler import run_chunks
+
+    initialize_distributed(
+        coordinator_address=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+    )
+    assert jax.process_count() == args.num_processes, jax.process_count()
+    me = jax.process_index()
+    assert me == args.process_id, (me, args.process_id)
+
+    # --- cross-process collective over the global mesh -----------------
+    n_global = args.num_processes * args.devices_per_process
+    assert len(jax.devices()) == n_global, len(jax.devices())
+    mesh = make_pixel_mesh()  # 1-D mesh over ALL global devices
+    n_pix = n_global * 8
+    sharding = NamedSharding(mesh, P("pixels"))
+    # Each process materialises only its addressable shards.
+    global_x = jax.make_array_from_callback(
+        (n_pix,), sharding,
+        lambda idx: np.arange(n_pix, dtype=np.float32)[idx],
+    )
+
+    @jax.jit
+    def global_sum(v):
+        return jnp.sum(v)  # GSPMD inserts the cross-process reduction
+
+    total = float(global_sum(global_x))
+    expect = float(n_pix * (n_pix - 1) / 2)
+    assert total == expect, (total, expect)
+
+    # --- chunk scheduler with the real process_index -------------------
+    chunks = list(get_chunks(64, 64, (32, 32)))  # 4 chunks
+    ran = []
+
+    def run_one(chunk, prefix):
+        ran.append(prefix)
+        with open(os.path.join(args.outdir, f"out_{prefix}.json"), "w") as f:
+            json.dump({"chunk": chunk.chunk_no, "process": me}, f)
+
+    stats = run_chunks(chunks, run_one, args.outdir)
+
+    with open(os.path.join(args.outdir, f"result_{me}.json"), "w") as f:
+        json.dump({
+            "process_index": me,
+            "process_count": jax.process_count(),
+            "global_devices": len(jax.devices()),
+            "local_devices": len(jax.local_devices()),
+            "collective_sum": total,
+            "collective_expected": expect,
+            "chunks_run": sorted(ran),
+            "stats": stats,
+        }, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

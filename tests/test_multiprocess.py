@@ -1,0 +1,86 @@
+"""True multi-process distributed test (VERDICT round-1 item 9).
+
+Spawns two real OS processes that meet at a localhost
+``jax.distributed.initialize`` coordinator, form one global device mesh,
+run a cross-process collective, and split the chunk scheduler's work by
+their genuine ``jax.process_index()`` — the end-to-end replacement for the
+reference's live-dask-cluster path (``kafka_test_Py36.py:242-255``) that
+round 1 only exercised with a faked process index.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_distributed_run(tmp_path):
+    port = _free_port()
+    outdir = str(tmp_path)
+    env = dict(os.environ)
+    # Bypass any TPU plugin sitecustomize: the children must come up on the
+    # host platform only, like independent cluster workers would.
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m",
+                "kafka_tpu.testing.multiprocess_worker",
+                "--coordinator", f"localhost:{port}",
+                "--num-processes", "2",
+                "--process-id", str(i),
+                "--outdir", outdir,
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out.decode(errors="replace"))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("distributed workers timed out\n" + "\n".join(outs))
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}"
+
+    results = {}
+    for i in range(2):
+        with open(os.path.join(outdir, f"result_{i}.json")) as f:
+            results[i] = json.load(f)
+
+    for i, r in results.items():
+        # Real two-process runtime with a 4-device global mesh
+        assert r["process_count"] == 2
+        assert r["global_devices"] == 4
+        assert r["local_devices"] == 2
+        # The cross-process psum saw every shard
+        assert r["collective_sum"] == r["collective_expected"]
+        # Round-robin: each process owned and ran exactly 2 of 4 chunks
+        assert r["stats"]["assigned"] == 2
+        assert r["stats"]["run"] == 2
+
+    # The union of both processes' chunks covers all four, disjointly
+    all_chunks = results[0]["chunks_run"] + results[1]["chunks_run"]
+    assert sorted(all_chunks) == ["0001", "0002", "0003", "0004"]
+    assert not set(results[0]["chunks_run"]) & set(results[1]["chunks_run"])
+    # And every chunk's marker + output landed in the shared directory
+    markers = [f for f in os.listdir(outdir) if f.endswith(".done")]
+    assert len(markers) == 4
