@@ -27,7 +27,7 @@ from ..core.linalg import spd_inverse_batched
 from ..core.solvers import assimilate_date_jit
 from ..core.time_grid import iterate_time_grid
 from ..core.types import BandBatch
-from .prefetch import ObservationPrefetcher, planned_observation_dates
+from .prefetch import ObservationPrefetcher
 from .protocols import DateObservation, ObservationSource, OutputWriter, Prior
 from .state import PixelGather, make_pixel_gather
 
@@ -198,10 +198,14 @@ class KalmanFilter:
             p_forecast_inverse = jnp.asarray(
                 p_forecast_inverse, jnp.float32
             )
+        # Snapshot the grid windowing ONCE: the run loop and the prefetch
+        # plan must see the identical date sequence even if the source's
+        # `dates` property recomputes between reads (else a plan/loop
+        # divergence would block forever on the prefetch queue).
+        windows = list(iterate_time_grid(time_grid, self.observations.dates))
         if self.prefetch_depth > 0:
-            plan = planned_observation_dates(
-                time_grid, self.observations.dates
-            )
+            plan = [d for _, locate_times, _ in windows
+                    for d in locate_times]
             if plan:
                 self._prefetcher = ObservationPrefetcher(
                     self.observations, self.gather, plan,
@@ -209,7 +213,7 @@ class KalmanFilter:
                 )
         try:
             return self._run_loop(
-                time_grid, x_forecast, p_forecast, p_forecast_inverse,
+                windows, x_forecast, p_forecast, p_forecast_inverse,
                 checkpointer, advance_first,
             )
         finally:
@@ -217,14 +221,12 @@ class KalmanFilter:
                 self._prefetcher.close()
                 self._prefetcher = None
 
-    def _run_loop(self, time_grid, x_forecast, p_forecast,
+    def _run_loop(self, windows, x_forecast, p_forecast,
                   p_forecast_inverse, checkpointer, advance_first):
         x_analysis, p_analysis, p_analysis_inverse = (
             x_forecast, p_forecast, p_forecast_inverse
         )
-        for timestep, locate_times, is_first in iterate_time_grid(
-            time_grid, self.observations.dates
-        ):
+        for timestep, locate_times, is_first in windows:
             if (not is_first) or advance_first:
                 LOG.info("Advancing state to %s", timestep)
                 x_forecast, p_forecast, p_forecast_inverse = self.advance(

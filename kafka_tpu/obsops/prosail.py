@@ -30,12 +30,23 @@ Model structure (all closed-form, fully differentiable):
    multiple scattering over a Lambertian soil, linear dry/wet soil mixing
    weighted by ``bsoil``/``psoil``.
 
-The per-band constituent absorption coefficients below are *band-effective*
-values for the 10 S2 bands of the reference's band map (B02..B8A, B09,
-B12) — the spectral shape of PROSPECT-5 averaged into bands.  They carry
-the correct physics structure (which is what the Jacobians see); absolute
-calibration against a full-spectrum PROSAIL run can refit ``BAND_K`` /
-``N_REFRACT`` without touching the model code.
+Calibration status (tests/test_prosail_calibration.py):
+
+- the **SAIL two-stream solution is exact**: ``sail_fluxes`` matches an
+  independent float64 finite-difference boundary-value oracle of the same
+  ODE system to <2e-3 across leaf/soil/LAI/LIDF regimes;
+- the **plate model matches a float64 SciPy-``exp1`` oracle** to <2e-3
+  (validating the branch-free E1 approximation under float32);
+- the per-band constituent absorption coefficients (``BAND_K``) are
+  *band-effective* values for the 10 S2 bands of the reference's band map
+  (B02..B8A, B09, B12), tuned so the canonical dense-canopy state
+  (N=1.5, Cab=40, Car=8, Cw=0.0176, Cm=0.009, LAI=3) lands inside the
+  published per-band reflectance windows of healthy vegetation (NIR
+  plateau 0.30-0.55, red < 0.07, red edge monotone, NDVI 0.75-0.97) with
+  the right sensitivity directions (Cab -> red, Cw -> SWIR, LAI -> NIR).
+  No full-spectrum PROSPECT-5 table ships in this environment; refitting
+  ``BAND_K``/``N_REFRACT``/soil spectra against one is a drop-in constant
+  swap that touches no model code.
 """
 
 from __future__ import annotations
@@ -72,11 +83,11 @@ N_REFRACT = np.array(
 #: from blue, water and dry matter in the SWIR.
 BAND_K = np.array([
     # B02    B03    B04    B05    B06    B07    B08    B8A    B09    B12
-    [0.045, 0.018, 0.062, 0.028, 0.006, 0.000, 0.000, 0.000, 0.000, 0.000],
+    [0.045, 0.018, 0.062, 0.012, 0.003, 0.000, 0.000, 0.000, 0.000, 0.000],
     [0.060, 0.008, 0.000, 0.000, 0.000, 0.000, 0.000, 0.000, 0.000, 0.000],
     [0.900, 0.450, 0.180, 0.100, 0.060, 0.040, 0.020, 0.015, 0.008, 0.000],
-    [0.000, 0.000, 0.000, 0.001, 0.002, 0.004, 0.008, 0.012, 0.450, 32.00],
-    [0.000, 0.000, 0.000, 0.000, 0.500, 0.800, 1.200, 1.400, 2.500, 55.00],
+    [0.000, 0.000, 0.000, 0.001, 0.002, 0.003, 0.005, 0.008, 0.450, 24.00],
+    [0.000, 0.000, 0.000, 0.000, 0.300, 0.500, 0.900, 1.000, 2.200, 28.00],
 ])
 
 #: Typical dry/wet soil reflectance spectra at the 10 bands (linear mixing
@@ -207,13 +218,177 @@ def ala_to_chi(ala_deg):
     return jnp.clip((57.3 - ala_deg) / 57.3, -0.4, 0.6)
 
 
+def _fit_bf_polynomial() -> np.ndarray:
+    """Host-side fit of ``bf = <cos^2 theta_l>`` as a cubic in the average
+    leaf angle (degrees), over the ellipsoidal LIDF family (Campbell):
+
+        g(theta; chi) ~ chi^3 sin(theta) / (cos^2 + chi^2 sin^2)^2
+
+    The SAIL layer coefficients need the second LIDF moment (``bf`` in
+    Verhoef's notation); parameterising it directly by ALA keeps the
+    operator differentiable in the ``ala`` state without tracing the LIDF
+    integral.  Exact for this family to the fit residual (<2e-3 over
+    ALA in [15, 80] deg)."""
+    trapezoid = getattr(np, "trapezoid", None) or np.trapz  # numpy 1.x/2.x
+    theta = np.linspace(1e-4, np.pi / 2 - 1e-4, 2000)
+    chis = np.geomspace(0.08, 12.0, 200)
+    alas, bfs = [], []
+    for chi in chis:
+        g = np.sin(theta) / (
+            np.cos(theta) ** 2 + chi**2 * np.sin(theta) ** 2
+        ) ** 2
+        g /= trapezoid(g, theta)
+        alas.append(np.rad2deg(trapezoid(theta * g, theta)))
+        bfs.append(trapezoid(np.cos(theta) ** 2 * g, theta))
+    return np.polyfit(np.asarray(alas), np.asarray(bfs), 3)
+
+
+_BF_POLY = _fit_bf_polynomial()
+
+
+def bf_from_ala(ala_deg):
+    """Second LIDF moment <cos^2 theta_l> from the average leaf angle."""
+    c = _BF_POLY
+    a = jnp.clip(ala_deg, 15.0, 80.0)
+    return jnp.clip(
+        ((c[0] * a + c[1]) * a + c[2]) * a + c[3], 0.02, 0.98
+    )
+
+
+def _j_exp_integral(p, q, lai):
+    """int_0^L e^{-p x} e^{-q x} dx = (1 - e^{-(p+q)L}) / (p+q), guarded
+    (Verhoef's J2-style integral)."""
+    s = p + q
+    s = jnp.where(jnp.abs(s) < _EPS, _EPS, s)
+    return (1.0 - jnp.exp(-s * lai)) / s
+
+
+def sail_fluxes(rho_l, tau_l, soil, lai, ks, ko, bf):
+    """Exact SAIL two-stream solution with the direct-beam source term.
+
+    Solves the coupled diffuse-flux ODE system of the SAIL model
+    analytically (eigenmodes e^{+-mx} + particular solution driven by the
+    direct beam e^{-ks x}, soil boundary U(L) = rs (D(L) + tss)) and
+    returns everything the BRF assembly needs.  Verhoef's closed-form
+    rsd/tsd/rdo/tdo coefficients are this same construction; deriving it
+    from the ODEs keeps every step checkable against a numerical
+    boundary-value oracle (tests/test_prosail_calibration.py).
+
+    Layer scattering coefficients from the LIDF second moment ``bf``
+    (SUITS/Verhoef):
+
+        sigb = ddb rho + ddf tau,  ddb = (1+bf)/2   (diffuse back)
+        sb   = sdb rho + sdf tau,  sdb = (ks+bf)/2  (direct -> diffuse up)
+        vb   = dob rho + dof tau,  dob = (ko+bf)/2  (diffuse -> view)
+    """
+    ddb, ddf = 0.5 * (1.0 + bf), 0.5 * (1.0 - bf)
+    sdb, sdf = 0.5 * (ks + bf), 0.5 * (ks - bf)
+    dob, dof = 0.5 * (ko + bf), 0.5 * (ko - bf)
+    sigb = ddb * rho_l + ddf * tau_l
+    sigf = ddf * rho_l + ddb * tau_l
+    att = 1.0 - sigf
+    # m -> 0 only for a perfectly conservative leaf (rho + tau = 1), where
+    # the two exponential modes degenerate into secular (1, x) solutions.
+    # Clamping m at 0.02 keeps the closed form well-conditioned and adds
+    # <1e-3 error for any physical leaf (single-scatter albedo < 0.998).
+    m = jnp.sqrt(jnp.maximum(att**2 - sigb**2, 4e-4))
+    sb = sdb * rho_l + sdf * tau_l
+    sf = sdf * rho_l + sdb * tau_l
+    vb = dob * rho_l + dof * tau_l
+    vf = dof * rho_l + dob * tau_l
+
+    # ks = m is a removable resonance (the particular solution collides
+    # with the decaying eigenmode; the true solution gains a secular
+    # x e^{-mx} term).  Rather than special-casing, nudge ks off the
+    # resonance and solve the ODE *exactly* for the nudged ks everywhere
+    # (source, BCs, view integrals stay mutually consistent): the error is
+    # |BRF(ks +- d) - BRF(ks)|, bounded by the solution's smoothness in
+    # ks (<~2e-3 for d = 0.02; sdb/sdf keep the physical ks).  Resonance
+    # only occurs for ks >~ 0.3, so det = ks^2 - m^2 stays >~ 0.012.
+    d_res = 0.02
+    diff = ks - m
+    ks = jnp.where(
+        jnp.abs(diff) < d_res,
+        m + jnp.where(diff >= 0.0, d_res, -d_res),
+        ks,
+    )
+    det = ks**2 - m**2
+    # Particular solution  D_p = a e^{-ks x},  U_p = b e^{-ks x} of
+    #   dD/dx = -att D + sigb U + sf Es,   dU/dx = att U - sigb D - sb Es
+    # (x downward, Es = e^{-ks x}); Cramer on the 2x2 system whose rhs is
+    # (sf, -sb): the beam feeds +sf into the downward equation and +sb
+    # into the upward one.
+    a_p = (-(att + ks) * sf - sigb * sb) / det
+    b_p = (-(att - ks) * sb - sigb * sf) / det
+
+    # Homogeneous modes: D ~ e^{-+mx}; U/D ratios rinf (decaying),
+    # 1/rinf (growing).
+    rinf = sigb / (att + m)
+    tss = jnp.exp(-ks * lai)
+    e_m = jnp.exp(-m * lai)
+
+    # Boundary conditions: D(0) = 0;  U(L) = rs (D(L) + tss).
+    #   A + B + a_p = 0
+    #   A rinf e^{-mL} + B e^{+mL}/rinf + b_p tss
+    #     = rs (A e^{-mL} + B e^{+mL} + a_p tss + tss)
+    # Scale B by e^{+mL} (B' = B e^{mL}) so nothing overflows for large
+    # m L: B = B' e^{-mL}.
+    c11, c12 = 1.0, e_m
+    c21 = (rinf - soil) * e_m
+    c22 = 1.0 / rinf - soil
+    r1 = -a_p
+    r2 = (soil * (a_p + 1.0) - b_p) * tss
+    det_bc = c11 * c22 - c12 * c21
+    det_bc = jnp.where(jnp.abs(det_bc) < _EPS, _EPS, det_bc)
+    aa = (r1 * c22 - c12 * r2) / det_bc
+    bb_s = (c11 * r2 - c21 * r1) / det_bc   # scaled B'
+
+    d_bottom = aa * e_m + bb_s + a_p * tss
+    u_bottom = soil * (d_bottom + tss)
+
+    # Directional radiance from leaf-scattered diffuse flux:
+    #   int_0^L (vb U + vf D) e^{-ko x} dx
+    # with U, D as sums of exponentials -> elementary integrals.  The
+    # growing mode is integrated in its scaled form:
+    #   B e^{+mx} e^{-ko x} = B' e^{-m(L-x)} e^{-ko x}; int_0^L =
+    #   B' e^{-mL} (e^{(m-ko)L} - 1)/(m-ko)  ==  B' J(koL, mL) stable form.
+    j_dec = _j_exp_integral(m, ko, lai)                   # decaying mode
+    s_g = ko - m
+    s_g = jnp.where(jnp.abs(s_g) < 1e-4, 1e-4, s_g)
+    j_gro = (jnp.exp(-m * lai) - jnp.exp(-ko * lai)) / s_g  # growing mode
+    j_par = _j_exp_integral(ks, ko, lai)                  # particular
+    rad_leaf = (
+        (vb * rinf + vf) * aa * j_dec
+        + (vb / rinf + vf) * bb_s * j_gro
+        + (vb * b_p + vf * a_p) * j_par
+    )
+    return {
+        "rad_leaf": rad_leaf,
+        "u_bottom": u_bottom,
+        "d_bottom": d_bottom,
+        "tss": tss,
+        "rdd_top": aa * rinf + bb_s / rinf * e_m + b_p,  # diffuse albedo
+        "m": m, "rinf": rinf, "a_p": a_p, "b_p": b_p,
+        "aa": aa, "bb_scaled": bb_s,
+        "sigb": sigb, "sigf": sigf, "sb": sb, "sf": sf,
+        "vb": vb, "vf": vf,
+    }
+
+
 def canopy_brf(rho_l, tau_l, soil, lai, ala_deg, sza_deg, vza_deg, raa_deg,
                hotspot: float = 0.01):
     """Top-of-canopy bidirectional reflectance factor per band.
 
-    SAIL-family decomposition: exact single scattering (sun -> leaf ->
-    view, with Kuusk hotspot correlation) + two-stream multiple scattering
-    + direct soil term.
+    SAIL decomposition with the diffuse part solved exactly:
+
+    1. **single scattering** sun -> leaf -> view with a Kuusk-style
+       hotspot gap correlation (bi-Lambertian area-scattering phase);
+    2. **diffuse field** from the closed-form two-stream boundary-value
+       solution (``sail_fluxes``): leaf-scattered diffuse radiance toward
+       the viewer plus the soil-reflected diffuse flux escaping through
+       the view-path gap fraction;
+    3. **soil direct-direct** through the hotspot-correlated two-way gap
+       probability.
     """
     ts = jnp.deg2rad(sza_deg)
     to = jnp.deg2rad(vza_deg)
@@ -257,40 +432,22 @@ def canopy_brf(rho_l, tau_l, soil, lai, ala_deg, sza_deg, vza_deg, raa_deg,
     f_hs = c_hs / jnp.maximum((ks + ko) * lai, _EPS)
     k_two = (ks + ko) * (1.0 - f_hs)
     brf_ss = gamma * (1.0 - jnp.exp(-k_two * lai)) / jnp.maximum(k_two, _EPS)
-    # view gap fraction and correlated two-way soil transmittance
-    tau_oo = jnp.exp(-ko * lai)
-    # correlated two-way soil transmittance (hotspot raises it)
+    # correlated two-way soil transmittance (hotspot raises it above
+    # tss * too)
     tau_sso = jnp.exp(-k_two * lai)
 
-    # Multiple scattering: two-flux (Kubelka-Munk) with diffuse extinction
-    # ~ G_bar / mu_bar, isotropic backscatter fraction from leaf optics.
-    att = 1.0 - 0.5 * w * (1.0 + _DIFF_BACK)      # alpha
-    bsc = 0.5 * w * _DIFF_BACK                    # beta
-    gam2 = jnp.sqrt(jnp.maximum(att**2 - bsc**2, _EPS**2))
-    r_inf = bsc / (att + gam2)
-    e_m = jnp.exp(-2.0 * gam2 * lai)              # diffuse path ~ 2 LAI
-    ratio = e_m * (r_inf - soil) / (soil - 1.0 / jnp.maximum(r_inf, _EPS))
-    c1 = 1.0 / (1.0 + ratio)
-    c2 = ratio * c1
-    r_dd = r_inf * c1 + c2 / jnp.maximum(r_inf, _EPS)
-    # diffuse (multiple-scatter) contribution reaching the viewer: total
-    # diffuse albedo minus what single scattering already accounted for,
-    # weighted by canopy interception along the view path
-    brf_ms = jnp.clip(
-        r_dd - gamma * (1.0 - jnp.exp(-2.0 * gam2 * lai))
-        / jnp.maximum(2.0 * gam2, _EPS),
-        0.0, 1.0,
-    ) * (1.0 - tau_oo)
-    # soil direct term seen through correlated gaps
+    # Exact diffuse field (two-stream BVP): leaf-scattered radiance toward
+    # the viewer + soil-reflected diffuse escaping through view gaps.
+    fx = sail_fluxes(rho_l, tau_l, soil, lai, ks, ko, bf_from_ala(ala_deg))
+    tau_oo = jnp.exp(-ko * lai)
+    brf_diffuse = fx["rad_leaf"] + fx["u_bottom"] * tau_oo \
+        - soil * fx["tss"] * tau_oo
+    # (the u_bottom term contains soil * tss * too already; subtract it and
+    # add the hotspot-correlated version instead)
     brf_soil = soil * tau_sso
 
-    brf = brf_ss + brf_ms + brf_soil
+    brf = brf_ss + brf_diffuse + brf_soil
     return jnp.clip(brf, 0.0, 1.0)
-
-
-#: Diffuse backscatter fraction for the two-flux multiple-scattering term
-#: (isotropic leaf orientation average).
-_DIFF_BACK = 0.5
 
 
 class ProsailAux(NamedTuple):

@@ -33,33 +33,46 @@ def test_two_process_distributed_run(tmp_path):
     env.pop("PYTHONPATH", None)
     env["JAX_PLATFORMS"] = "cpu"
 
-    procs = [
-        subprocess.Popen(
-            [
-                sys.executable, "-m",
-                "kafka_tpu.testing.multiprocess_worker",
-                "--coordinator", f"localhost:{port}",
-                "--num-processes", "2",
-                "--process-id", str(i),
-                "--outdir", outdir,
-            ],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-        )
-        for i in range(2)
-    ]
-    outs = []
+    # Children log to files, not pipes: two piped children meeting at a
+    # collective can deadlock on a full OS pipe buffer while the parent
+    # drains them sequentially.
+    log_paths = [os.path.join(outdir, f"worker_{i}.log") for i in range(2)]
+    logs = [open(p, "wb") for p in log_paths]
     try:
-        for p in procs:
-            out, _ = p.communicate(timeout=180)
-            outs.append(out.decode(errors="replace"))
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        pytest.fail("distributed workers timed out\n" + "\n".join(outs))
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{out}"
+        procs = [
+            subprocess.Popen(
+                [
+                    sys.executable, "-m",
+                    "kafka_tpu.testing.multiprocess_worker",
+                    "--coordinator", f"localhost:{port}",
+                    "--num-processes", "2",
+                    "--process-id", str(i),
+                    "--outdir", outdir,
+                ],
+                env=env,
+                stdout=logs[i],
+                stderr=subprocess.STDOUT,
+            )
+            for i in range(2)
+        ]
+        try:
+            for p in procs:
+                p.wait(timeout=180)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+    finally:
+        for f in logs:
+            f.close()
+
+    def logs_text():
+        return "\n".join(
+            f"--- worker {i} ---\n" + open(p, errors="replace").read()
+            for i, p in enumerate(log_paths)
+        )
+
+    for i, p in enumerate(procs):
+        assert p.returncode == 0, f"worker {i} failed:\n{logs_text()}"
 
     results = {}
     for i in range(2):

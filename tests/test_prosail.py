@@ -98,10 +98,14 @@ class TestCanopyBRF:
         np.testing.assert_allclose(brf, soil, atol=0.01)
 
     def test_dense_canopy_ignores_soil(self):
-        """LAI -> large: soil brightness must stop mattering."""
+        """LAI -> large: soil brightness must stop mattering.  In the NIR
+        (single-scatter albedo ~0.95) the diffuse field penetrates deep —
+        e^{-mL} ~ 0.17 at LAI 8 — so a small residual soil effect is
+        physical; only the visible bands extinguish it completely."""
         b1 = np.asarray(OP.forward_pixel(AUX, make_state(lai=8.0, bsoil=0.2)))
         b2 = np.asarray(OP.forward_pixel(AUX, make_state(lai=8.0, bsoil=1.8)))
-        np.testing.assert_allclose(b1, b2, atol=0.01)
+        np.testing.assert_allclose(b1[:5], b2[:5], atol=0.005)  # VIS/red edge
+        np.testing.assert_allclose(b1, b2, atol=0.03)           # incl. NIR
 
     def test_red_edge(self):
         """A vegetated canopy must be much brighter in NIR than red."""

@@ -1,0 +1,311 @@
+"""PROSAIL operator calibration tests (VERDICT round-1 item 6).
+
+Three layers of quantitative checks, replacing the round-1 suite's purely
+qualitative physics assertions:
+
+1. **Flux-solution parity**: the closed-form SAIL two-stream solution
+   (``sail_fluxes``) against an independent finite-difference boundary-
+   value oracle of the same ODE system (float64, 20k layers) — validates
+   the eigenmode/particular/BC algebra to ~1e-3 across leaf optics,
+   LIDF moments, soils and LAI.
+2. **Plate-model parity**: the jitted leaf model against a float64 oracle
+   using SciPy's exact exponential integral (validates the branch-free
+   E1 approximation and float32 stability).
+3. **Canonical signatures**: reflectance windows per S2 band for the
+   standard PROSAIL validation state (N=1.5, Cab=40, Car=8, Cw=0.0176,
+   Cm=0.009, LAI=3, spherical LIDF) — the published behaviour of healthy
+   dense vegetation — plus directional sensitivity checks (chlorophyll ->
+   red, water -> SWIR, LAI -> NIR plateau monotone).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+import scipy.sparse as sp
+import scipy.sparse.linalg as spl
+from scipy.special import exp1
+
+from kafka_tpu.obsops.prosail import (
+    BAND_K,
+    N_REFRACT,
+    ProsailAux,
+    ProsailOperator,
+    _TAV40,
+    _TAV90,
+    bf_from_ala,
+    leaf_optics,
+    sail_fluxes,
+)
+
+
+# ---------------------------------------------------------------------------
+# 1. SAIL flux solution vs finite-difference BVP oracle
+# ---------------------------------------------------------------------------
+
+
+def bvp_oracle(rho, tau, soil, lai, ks, ko, bf, n=20000):
+    """Float64 finite-difference solve of the SAIL diffuse-flux system:
+
+        dD/dx = -att D + sigb U + sf e^{-ks x}
+        dU/dx =  att U - sigb D - sb e^{-ks x}
+        D(0) = 0,  U(L) = soil (D(L) + e^{-ks L})
+
+    Returns the same quantities as ``sail_fluxes``.
+    """
+    ddb, ddf = 0.5 * (1 + bf), 0.5 * (1 - bf)
+    sdb, sdf = 0.5 * (ks + bf), 0.5 * (ks - bf)
+    dob, dof = 0.5 * (ko + bf), 0.5 * (ko - bf)
+    sigb = ddb * rho + ddf * tau
+    sigf = ddf * rho + ddb * tau
+    att = 1 - sigf
+    sb = sdb * rho + sdf * tau
+    sf = sdf * rho + sdb * tau
+    vb = dob * rho + dof * tau
+    vf = dof * rho + dob * tau
+
+    x = np.linspace(0.0, lai, n + 1)
+    h = x[1] - x[0]
+    es = np.exp(-ks * x)
+    A = sp.lil_matrix((2 * (n + 1), 2 * (n + 1)))
+    b = np.zeros(2 * (n + 1))
+    for i in range(1, n):
+        A[2 * i, 2 * (i + 1)] += 1 / (2 * h)
+        A[2 * i, 2 * (i - 1)] -= 1 / (2 * h)
+        A[2 * i, 2 * i] += att
+        A[2 * i, 2 * i + 1] -= sigb
+        b[2 * i] = sf * es[i]
+        A[2 * i + 1, 2 * (i + 1) + 1] += 1 / (2 * h)
+        A[2 * i + 1, 2 * (i - 1) + 1] -= 1 / (2 * h)
+        A[2 * i + 1, 2 * i + 1] -= att
+        A[2 * i + 1, 2 * i] += sigb
+        b[2 * i + 1] = -sb * es[i]
+    A[0, 0] = 1.0                       # D(0) = 0
+    A[1, 3] += 1 / h                    # forward difference for U at top
+    A[1, 1] += -1 / h - att
+    A[1, 0] += sigb
+    b[1] = -sb * es[0]
+    A[2 * n + 1, 2 * n + 1] = 1.0       # soil boundary
+    A[2 * n + 1, 2 * n] = -soil
+    b[2 * n + 1] = soil * np.exp(-ks * lai)
+    A[2 * n, 2 * n] += 1 / h + att      # backward difference for D at L
+    A[2 * n, 2 * (n - 1)] += -1 / h
+    A[2 * n, 2 * n + 1] -= sigb
+    b[2 * n] = sf * es[n]
+    sol = spl.spsolve(A.tocsr(), b)
+    d, u = sol[0::2], sol[1::2]
+    return {
+        "rad_leaf": np.trapezoid((vb * u + vf * d) * np.exp(-ko * x), x),
+        "u_bottom": u[-1],
+        "d_bottom": d[-1],
+        "rdd_top": u[0],
+    }
+
+
+FLUX_CASES = [
+    # rho, tau, soil, lai, ks, ko, bf          — regime
+    (0.47, 0.48, 0.20, 3.0, 0.577, 0.500, 1 / 3),   # NIR, dense
+    (0.05, 0.04, 0.15, 3.0, 0.577, 0.500, 1 / 3),   # red, dense
+    (0.47, 0.48, 0.25, 0.5, 0.577, 0.500, 1 / 3),   # NIR, sparse
+    (0.30, 0.30, 0.10, 5.0, 0.800, 0.600, 0.60),    # planophile, oblique
+    (0.15, 0.10, 0.30, 1.5, 0.450, 1.000, 0.15),    # erectophile
+    (0.09, 0.06, 0.35, 2.0, 0.577, 0.577, 1 / 3),   # SWIR over bright soil
+    # exact ks = m resonance (red leaf at sza ~ 57 deg): the removable
+    # singularity handled by the consistent ks nudge
+    (0.09, 0.06, 0.15, 3.0, 0.9265527507918803, 0.5, 1 / 3),
+]
+
+
+class TestFluxParity:
+    @pytest.mark.parametrize("rho,tau,soil,lai,ks,ko,bf", FLUX_CASES)
+    def test_matches_bvp_oracle(self, rho, tau, soil, lai, ks, ko, bf):
+        fx = sail_fluxes(*map(jnp.asarray, (rho, tau, soil, lai, ks, ko,
+                                            bf)))
+        want = bvp_oracle(rho, tau, soil, lai, ks, ko, bf)
+        for key, expect in want.items():
+            got = float(fx[key])
+            assert got == pytest.approx(expect, abs=2e-3), (
+                f"{key}: analytic {got} vs oracle {expect}"
+            )
+
+    def test_energy_balance_near_conservative_leaf(self):
+        """With a nearly non-absorbing leaf (rho + tau = 0.996) over a
+        black soil, reflected + transmitted + beam energy must equal
+        incident minus the small leaf absorption.  (The exactly
+        conservative limit is a degenerate eigenproblem the closed form
+        clamps away from — physical leaves always absorb.)"""
+        rho, tau = 0.499, 0.497
+        lai, ks, bf = 2.0, 0.577, 1 / 3
+        fx = sail_fluxes(*map(jnp.asarray, (rho, tau, 0.0, lai, ks, 0.5,
+                                            bf)))
+        want = bvp_oracle(rho, tau, 0.0, lai, ks, 0.5, bf)
+        total = float(fx["rdd_top"]) + float(fx["d_bottom"]) + float(
+            fx["tss"]
+        )
+        total_oracle = want["rdd_top"] + want["d_bottom"] + np.exp(
+            -ks * lai
+        )
+        assert total == pytest.approx(total_oracle, abs=5e-3)
+        assert 0.97 <= total <= 1.0  # tiny absorption only
+
+
+# ---------------------------------------------------------------------------
+# 2. Plate model vs float64 SciPy oracle
+# ---------------------------------------------------------------------------
+
+
+def plate_oracle(n_layers, cab, car, cbrown, cw, cm):
+    """Float64 generalized plate model with SciPy's exact E1."""
+    k = (BAND_K * np.array([cab, car, cbrown, cw, cm])[:, None]).sum(0)
+    k = np.maximum(k / max(n_layers, 1.0), 1e-6)
+    trans = (1 - k) * np.exp(-k) + k**2 * exp1(k)
+    trans = np.clip(trans, 1e-6, 1 - 1e-6)
+    t21 = _TAV90 / N_REFRACT**2
+    r21 = 1 - t21
+    r12 = 1 - _TAV90
+    talf, ralf = _TAV40, 1 - _TAV40
+    denom = 1 - r21**2 * trans**2
+    ta = talf * trans * t21 / denom
+    ra = ralf + r21 * trans * ta
+    t = _TAV90 * trans * t21 / denom
+    r = r12 + r21 * trans * t
+    t = np.clip(t, 1e-6, 1 - 1e-6)
+    r = np.clip(r, 1e-6, 1 - 1e-6)
+    d = np.sqrt(np.maximum(
+        (1 + r + t) * (1 + r - t) * (1 - r + t) * (1 - r - t), 1e-12
+    ))
+    a = (1 + r**2 - t**2 + d) / (2 * r)
+    b = (1 - r**2 + t**2 + d) / (2 * t)
+    m = max(n_layers - 1.0, 1e-6)
+    bnm1 = np.power(np.maximum(b, 1 + 1e-6), m)
+    denom2 = a**2 * bnm1**2 - 1
+    rsub = a * (bnm1**2 - 1) / denom2
+    tsub = bnm1 * (a**2 - 1) / denom2
+    denom3 = 1 - rsub * r
+    return ra + ta * rsub * t / denom3, ta * tsub / denom3
+
+
+LEAF_CASES = [
+    (1.5, 40.0, 8.0, 0.0, 0.0176, 0.009),
+    (1.2, 20.0, 5.0, 0.1, 0.0100, 0.005),
+    (2.5, 70.0, 15.0, 0.0, 0.0300, 0.012),
+    (1.8, 5.0, 2.0, 0.5, 0.0050, 0.002),
+]
+
+
+class TestPlateParity:
+    @pytest.mark.parametrize("n,cab,car,cbrown,cw,cm", LEAF_CASES)
+    def test_matches_scipy_oracle(self, n, cab, car, cbrown, cw, cm):
+        rho, tau = leaf_optics(*map(jnp.asarray, (n, cab, car, cbrown, cw,
+                                                  cm)))
+        rho_o, tau_o = plate_oracle(n, cab, car, cbrown, cw, cm)
+        np.testing.assert_allclose(np.asarray(rho), rho_o, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(tau), tau_o, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# 3. Canonical signatures + sensitivities
+# ---------------------------------------------------------------------------
+
+
+def standard_state(cab=40.0, cw=0.0176, cm=0.009, lai=3.0):
+    return jnp.asarray([
+        1.5, np.exp(-cab / 100), np.exp(-8.0 / 100), 0.0,
+        np.exp(-50 * cw), np.exp(-100 * cm), np.exp(-lai / 2),
+        57.3 / 90, 1.0, 0.5,
+    ], jnp.float32)
+
+
+AUX = ProsailAux(
+    sza=jnp.asarray(30.0), vza=jnp.asarray(0.0), raa=jnp.asarray(0.0)
+)
+
+#: Plausibility windows for healthy dense vegetation (LAI 3, Cab 40) per
+#: S2 band — the published shape of the canopy reflectance spectrum.
+BAND_WINDOWS = [
+    # band   lo     hi
+    ("B02", 0.005, 0.06),
+    ("B03", 0.02, 0.10),
+    ("B04", 0.005, 0.07),
+    ("B05", 0.03, 0.15),
+    ("B06", 0.12, 0.35),
+    ("B07", 0.30, 0.55),
+    ("B08", 0.30, 0.55),
+    ("B8A", 0.30, 0.55),
+    ("B09", 0.25, 0.50),
+    ("B12", 0.02, 0.20),
+]
+
+
+class TestCanonicalSignatures:
+    def setup_method(self):
+        self.op = ProsailOperator()
+
+    def brf(self, x):
+        return np.asarray(self.op.forward(AUX, x[None, :]))[:, 0]
+
+    def test_dense_canopy_band_windows(self):
+        brf = self.brf(standard_state())
+        for (name, lo, hi), val in zip(BAND_WINDOWS, brf):
+            assert lo <= val <= hi, f"{name}: {val:.3f} not in [{lo}, {hi}]"
+
+    def test_ndvi_dense_canopy(self):
+        brf = self.brf(standard_state())
+        ndvi = (brf[6] - brf[2]) / (brf[6] + brf[2])
+        assert 0.75 <= ndvi <= 0.97
+
+    def test_nir_plateau_monotone_in_lai(self):
+        nir = [self.brf(standard_state(lai=lai))[6]
+               for lai in (0.5, 1.0, 2.0, 3.0, 5.0)]
+        assert all(b > a for a, b in zip(nir, nir[1:]))
+        assert 0.30 <= nir[-2] <= 0.55  # LAI 3 plateau
+
+    def test_red_increases_when_chlorophyll_drops(self):
+        hi = self.brf(standard_state(cab=40.0))[2]
+        lo = self.brf(standard_state(cab=10.0))[2]
+        assert lo > 2.0 * hi
+
+    def test_swir_increases_when_water_drops(self):
+        moist = self.brf(standard_state(cw=0.0176))[9]
+        dry = self.brf(standard_state(cw=0.004))[9]
+        assert dry > 1.5 * moist
+
+    def test_red_edge_monotone(self):
+        brf = self.brf(standard_state())
+        # B04 < B05 < B06 < B07 — the red edge climbs
+        assert brf[2] < brf[3] < brf[4] < brf[5]
+
+    def test_bare_soil_low_ndvi(self):
+        x = standard_state().at[6].set(0.999).at[8].set(1.0).at[9].set(1.0)
+        brf = self.brf(x)
+        ndvi = (brf[6] - brf[2]) / (brf[6] + brf[2])
+        assert ndvi < 0.35
+        # soil spectrum monotone brightening into the SWIR
+        assert brf[9] > brf[2]
+
+    def test_hotspot_brightens_backscatter(self):
+        """Reflectance in the exact backscatter direction must exceed the
+        same geometry away from the hotspot (the Kuusk correlation)."""
+        op = ProsailOperator()
+        x = standard_state()
+        hot = ProsailAux(sza=jnp.asarray(30.0), vza=jnp.asarray(30.0),
+                         raa=jnp.asarray(0.0))
+        cold = ProsailAux(sza=jnp.asarray(30.0), vza=jnp.asarray(30.0),
+                          raa=jnp.asarray(120.0))
+        b_hot = np.asarray(op.forward(hot, x[None, :]))[:, 0]
+        b_cold = np.asarray(op.forward(cold, x[None, :]))[:, 0]
+        assert b_hot[6] > b_cold[6]
+
+
+class TestLIDFMoment:
+    def test_spherical_second_moment(self):
+        """Spherical LIDF (ALA ~ 57.3 deg) has <cos^2> = 1/3."""
+        assert float(bf_from_ala(57.3)) == pytest.approx(1 / 3, abs=0.05)
+
+    def test_monotone_decreasing_in_ala(self):
+        vals = [float(bf_from_ala(a)) for a in (20.0, 35.0, 50.0, 65.0,
+                                                80.0)]
+        assert all(b > a for a, b in zip(vals[1:], vals))
+
+    def test_limits(self):
+        assert float(bf_from_ala(16.0)) > 0.75   # planophile: cos^2 -> 1
+        assert float(bf_from_ala(79.0)) < 0.12   # erectophile: cos^2 -> 0
