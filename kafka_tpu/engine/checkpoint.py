@@ -4,10 +4,17 @@ The reference has no resume mechanism — SURVEY.md §5 flags it as a cited
 gap: every timestep dumps mean/sigma GeoTIFFs (``linear_kf.py:210-212``) and
 keeps ``Previous_State`` in memory (``linear_kf.py:51-52,351-352``) but never
 persists or reloads it.  This module closes the gap: the full analysis state
-(mean + information matrix) is written per timestep as compressed ``.npz``,
-and a run can resume from the latest (or any) checkpoint, which also gives
-per-chunk restartability for the distributed scheduler (the reference's
+(mean + information matrix) is written per timestep and a run can resume
+from the latest (or any) checkpoint, which also gives per-chunk
+restartability for the distributed scheduler (the reference's
 cheap-rerun-by-chunk property, ``kafka_test_Py36.py:164-166``).
+
+Storage is scale-aware: the per-pixel information matrix is symmetric, so
+only its lower triangle is persisted — ``p(p+1)/2`` instead of ``p**2``
+floats per pixel (45% smaller at p=10 before compression) — and the pixel
+axis can be split across ``n_shards`` independent ``.npz`` files so a
+north-star-scale tile (10980**2 px) checkpoints as parallel-writable,
+individually-rereadable pieces instead of one monolithic array.
 """
 
 from __future__ import annotations
@@ -19,58 +26,145 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-_FMT = "state_%Y%m%dT%H%M%S.npz"
-_RX = re.compile(r"state_(\d{8}T\d{6})\.npz$")
+_FMT = "%Y%m%dT%H%M%S"
+_RX = re.compile(r"state_(\d{8}T\d{6})(?:\.shard(\d+)of(\d+))?\.npz$")
+
+
+def pack_tril(a: np.ndarray) -> np.ndarray:
+    """Symmetric ``(..., p, p)`` -> packed lower triangle ``(..., p(p+1)/2)``."""
+    p = a.shape[-1]
+    i, j = np.tril_indices(p)
+    return np.ascontiguousarray(a[..., i, j])
+
+
+def unpack_tril(packed: np.ndarray, p: int) -> np.ndarray:
+    """Packed lower triangle -> full symmetric ``(..., p, p)``."""
+    i, j = np.tril_indices(p)
+    out = np.zeros(packed.shape[:-1] + (p, p), packed.dtype)
+    out[..., i, j] = packed
+    out[..., j, i] = packed
+    return out
 
 
 class Checkpointer:
-    def __init__(self, folder: str, prefix: str = ""):
+    """Per-timestep state persistence.
+
+    ``n_shards > 1`` splits the pixel axis into that many independent
+    files per timestep (``state_<ts>.shard<k>of<n>.npz``); ``load_latest``
+    only considers timesteps whose shard set is complete, so a crash
+    mid-save resumes from the previous intact checkpoint.
+    """
+
+    def __init__(self, folder: str, prefix: str = "", n_shards: int = 1,
+                 dtype=np.float32):
+        if n_shards < 1:
+            raise ValueError(f"n_shards must be >= 1, got {n_shards}")
         self.folder = folder
         self.prefix = prefix
+        self.n_shards = int(n_shards)
+        self.dtype = np.dtype(dtype)
         os.makedirs(folder, exist_ok=True)
 
-    def _path(self, timestep: datetime.datetime) -> str:
-        return os.path.join(
-            self.folder, self.prefix + timestep.strftime(_FMT)
-        )
+    def _path(self, timestep: datetime.datetime, shard: int) -> str:
+        stamp = timestep.strftime(_FMT)
+        name = (f"state_{stamp}.npz" if self.n_shards == 1
+                else f"state_{stamp}.shard{shard}of{self.n_shards}.npz")
+        return os.path.join(self.folder, self.prefix + name)
 
     def save(self, timestep: datetime.datetime, x_analysis,
-             p_analysis_inverse) -> str:
-        path = self._path(timestep)
-        np.savez_compressed(
-            path,
-            x_analysis=np.asarray(x_analysis),
-            p_analysis_inverse=(
-                np.zeros((0,)) if p_analysis_inverse is None
-                else np.asarray(p_analysis_inverse)
-            ),
-        )
-        return path
+             p_analysis_inverse) -> List[str]:
+        x = np.asarray(x_analysis, self.dtype)
+        n_pix = x.shape[0] if x.ndim > 1 else x.size
+        if p_analysis_inverse is None:
+            tril = np.zeros((n_pix, 0), self.dtype)
+            p = 0
+        else:
+            full = np.asarray(p_analysis_inverse)
+            p = full.shape[-1]
+            tril = pack_tril(full).astype(self.dtype, copy=False)
+        paths = []
+        bounds = np.linspace(0, n_pix, self.n_shards + 1).astype(int)
+        for shard in range(self.n_shards):
+            lo, hi = bounds[shard], bounds[shard + 1]
+            path = self._path(timestep, shard)
+            np.savez_compressed(
+                path,
+                x_analysis=x[lo:hi],
+                p_inv_tril=tril[lo:hi],
+                p=np.int64(p),
+            )
+            paths.append(path)
+        return paths
 
-    def list_checkpoints(self) -> List[Tuple[datetime.datetime, str]]:
-        out = []
+    def list_checkpoints(self) -> List[Tuple[datetime.datetime, List[str]]]:
+        """Timesteps with a COMPLETE shard set, oldest first.
+
+        Shards are grouped by their ``of<total>`` declaration, so leftovers
+        from a run with a different ``n_shards`` can never be mixed into a
+        set (each file's shard count must agree).  If several totals have a
+        complete set for one timestep (e.g. an old 2-shard and a finished
+        3-shard save), the most recently written set wins."""
+        by_ts: dict = {}
         if not os.path.isdir(self.folder):
-            return out
+            return []
         for name in sorted(os.listdir(self.folder)):
             if not name.startswith(self.prefix):
                 continue
             m = _RX.search(name)
-            if m:
-                ts = datetime.datetime.strptime(m.group(1), "%Y%m%dT%H%M%S")
-                out.append((ts, os.path.join(self.folder, name)))
+            if not m:
+                continue
+            ts = datetime.datetime.strptime(m.group(1), _FMT)
+            shard = int(m.group(2)) if m.group(2) else 0
+            total = int(m.group(3)) if m.group(3) else 1
+            group = by_ts.setdefault(ts, {}).setdefault(total, {})
+            group[shard] = os.path.join(self.folder, name)
+        out = []
+        for ts in sorted(by_ts):
+            complete = []
+            for total, shards in by_ts[ts].items():
+                if set(shards) == set(range(total)):
+                    paths = [shards[k] for k in range(total)]
+                    complete.append(
+                        (max(os.path.getmtime(p) for p in paths), paths)
+                    )
+            if complete:
+                out.append((ts, max(complete)[1]))
         return out
 
-    def load_latest(self) -> Optional[Tuple[datetime.datetime, np.ndarray,
-                                            Optional[np.ndarray]]]:
+    def load_latest(self, shard: Optional[int] = None,
+                    ) -> Optional[Tuple[datetime.datetime, np.ndarray,
+                                        Optional[np.ndarray]]]:
         """Returns (timestep, x_analysis, p_analysis_inverse) of the newest
-        checkpoint, or None."""
+        complete checkpoint, or None.
+
+        ``shard`` restricts loading to that shard's pixel slice — the
+        per-piece path for chunk-level restarts at scales where the
+        assembled full matrix would not fit host RAM (the shards partition
+        the pixel axis in order, ``np.linspace`` bounds as written)."""
         ckpts = self.list_checkpoints()
         if not ckpts:
             return None
-        ts, path = ckpts[-1]
-        data = np.load(path)
-        p_inv = data["p_analysis_inverse"]
-        return ts, data["x_analysis"], (None if p_inv.size == 0 else p_inv)
+        ts, paths = ckpts[-1]
+        if shard is not None:
+            paths = [paths[shard]]
+        xs, trils, p = [], [], 0
+        for path in paths:
+            data = np.load(path)
+            xs.append(data["x_analysis"])
+            if "p_inv_tril" in data:
+                trils.append(data["p_inv_tril"])
+                p = int(data["p"])
+            else:  # round-1 full-matrix layout
+                full = data["p_analysis_inverse"]
+                if full.size:
+                    p = full.shape[-1]
+                    trils.append(pack_tril(full))
+        x = np.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
+        if p == 0:
+            return ts, x, None
+        tril = (np.concatenate(trils, axis=0) if len(trils) > 1
+                else trils[0])
+        return ts, x, unpack_tril(tril.astype(np.float32), p)
 
     def resume_time_grid(self, time_grid):
         """Trim a time grid to the steps strictly after the last checkpoint.

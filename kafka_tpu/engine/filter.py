@@ -30,6 +30,7 @@ from ..core.types import BandBatch
 from .prefetch import ObservationPrefetcher
 from .protocols import DateObservation, ObservationSource, OutputWriter, Prior
 from .state import PixelGather, make_pixel_gather
+from ..utils.profiling import annotate, trace
 
 LOG = logging.getLogger(__name__)
 
@@ -182,7 +183,7 @@ class KalmanFilter:
         return x_a, p_a, p_inv_a
 
     def run(self, time_grid, x_forecast, p_forecast, p_forecast_inverse,
-            checkpointer=None, advance_first=False):
+            checkpointer=None, advance_first=False, profile_dir=None):
         """Full assimilation run (``LinearKalman.run``,
         linear_kf.py:171-212).  ``x_forecast`` may be (n_pad, p) batched or
         the reference's flat interleaved layout.
@@ -190,7 +191,11 @@ class KalmanFilter:
         ``advance_first=True`` applies the state propagation/prior blend
         before the FIRST grid step too — required when resuming from a
         checkpoint, where the loaded state is an *analysis* whose advance
-        into the first resumed window hasn't happened yet."""
+        into the first resumed window hasn't happened yet.
+
+        ``profile_dir`` captures a ``jax.profiler`` trace of the whole run
+        into that directory (TensorBoard/Perfetto-viewable), with engine
+        phases labelled via TraceAnnotation spans."""
         x_forecast = jnp.asarray(x_forecast, jnp.float32).reshape(
             -1, self.n_params
         )
@@ -212,10 +217,11 @@ class KalmanFilter:
                     depth=self.prefetch_depth,
                 )
         try:
-            return self._run_loop(
-                windows, x_forecast, p_forecast, p_forecast_inverse,
-                checkpointer, advance_first,
-            )
+            with trace(profile_dir):
+                return self._run_loop(
+                    windows, x_forecast, p_forecast, p_forecast_inverse,
+                    checkpointer, advance_first,
+                )
         finally:
             if self._prefetcher is not None:
                 self._prefetcher.close()
@@ -229,28 +235,34 @@ class KalmanFilter:
         for timestep, locate_times, is_first in windows:
             if (not is_first) or advance_first:
                 LOG.info("Advancing state to %s", timestep)
-                x_forecast, p_forecast, p_forecast_inverse = self.advance(
-                    x_analysis, p_analysis, p_analysis_inverse, timestep
-                )
+                with annotate("kafka/advance"):
+                    x_forecast, p_forecast, p_forecast_inverse = (
+                        self.advance(
+                            x_analysis, p_analysis, p_analysis_inverse,
+                            timestep,
+                        )
+                    )
             if len(locate_times) == 0:
                 LOG.info("No observations in window ending %s", timestep)
                 x_analysis = x_forecast
                 p_analysis = p_forecast
                 p_analysis_inverse = p_forecast_inverse
             else:
-                x_analysis, p_analysis, p_analysis_inverse = (
-                    self.assimilate_dates(
-                        locate_times, x_forecast, p_forecast,
-                        p_forecast_inverse,
+                with annotate("kafka/assimilate"):
+                    x_analysis, p_analysis, p_analysis_inverse = (
+                        self.assimilate_dates(
+                            locate_times, x_forecast, p_forecast,
+                            p_forecast_inverse,
+                        )
                     )
-                )
             p_inv_diag = self._information_diagonal(
                 p_analysis, p_analysis_inverse
             )
-            self.output.dump_data(
-                timestep, np.asarray(x_analysis), p_inv_diag, self.gather,
-                self.parameter_list,
-            )
+            with annotate("kafka/dump"):
+                self.output.dump_data(
+                    timestep, np.asarray(x_analysis), p_inv_diag,
+                    self.gather, self.parameter_list,
+                )
             if checkpointer is not None:
                 checkpointer.save(
                     timestep, x_analysis, p_analysis_inverse

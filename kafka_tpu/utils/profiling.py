@@ -1,0 +1,50 @@
+"""Profiling hooks (SURVEY.md §5: the reference has none — its nearest
+thing is timestamped DEBUG logging, ``kafka_test.py:4-8``).
+
+Two layers:
+
+- :func:`trace` — a ``jax.profiler.trace`` context manager that captures a
+  full XLA/TPU trace (HLO timelines, device occupancy) viewable in
+  TensorBoard / Perfetto, no-op when no logdir is given.
+- :func:`annotate` — named host-side phase annotations
+  (``jax.profiler.TraceAnnotation``) so engine phases (advance /
+  assimilate / dump) show up as labelled spans inside the trace.
+
+Both degrade to no-ops if ``jax.profiler`` is unavailable so host-only
+tools (readers, writers) can annotate unconditionally.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def trace(logdir: Optional[str]) -> Iterator[None]:
+    """Capture a JAX profiler trace into ``logdir`` (no-op if ``None`` or
+    if ``jax.profiler`` is unavailable)."""
+    if not logdir:
+        yield
+        return
+    try:
+        import jax.profiler
+        ctx = jax.profiler.trace(logdir)
+    except Exception:  # pragma: no cover - profiler unavailable
+        yield
+        return
+    with ctx:
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Label the enclosed host work as a named span in profiler traces."""
+    try:
+        import jax.profiler
+        ctx = jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover - profiler unavailable
+        yield
+        return
+    with ctx:
+        yield
