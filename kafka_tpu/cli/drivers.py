@@ -62,16 +62,23 @@ def run_one_chunk(
     full_mask: np.ndarray,
     geo,
     aux_builder: Optional[Callable] = None,
+    operator=None,
 ) -> Optional[dict]:
     """One chunk's full assimilation: reader, prior, filter, outputs.
 
     Returns a summary dict, or None when the chunk's mask is empty (the
     reference's mask-nonempty guard, ``kafka_test_Py36.py:155-157``).
+
+    ``operator`` should be the ONE instance shared across chunks: the
+    jitted per-date solver is cache-keyed on the operator's bound
+    ``linearize``, so a fresh instance per chunk would recompile the
+    whole program for every chunk.
     """
     sub_mask = chunk_mask(full_mask, chunk)
     if not sub_mask.any():
         return None
-    operator = cfg.make_operator()
+    if operator is None:
+        operator = cfg.make_operator()
     gt = chunk_geotransform(geo.geotransform, chunk)
     obs = cfg.make_observations(
         operator, state_geo=(gt, geo.epsg), aux_builder=aux_builder
@@ -135,9 +142,15 @@ def run_config(
     ny, nx = full_mask.shape
     chunks = list(get_chunks(nx, ny, tuple(cfg.chunk_size)))
     summaries = []
+    # One operator for ALL chunks — keeps the jitted solver's compile
+    # cache warm across the chunk loop (see run_one_chunk).
+    operator = cfg.make_operator()
 
     def run_one(chunk, prefix):
-        s = run_one_chunk(cfg, chunk, prefix, full_mask, geo, aux_builder)
+        s = run_one_chunk(
+            cfg, chunk, prefix, full_mask, geo, aux_builder,
+            operator=operator,
+        )
         if s is not None:
             summaries.append(s)
             LOG.info("chunk %s: %s", prefix, json.dumps(s))
@@ -148,4 +161,7 @@ def run_config(
     )
     stats["chunks_with_pixels"] = len(summaries)
     stats["pixels"] = int(sum(s["n_pixels"] for s in summaries))
+    stats["dates_assimilated"] = int(
+        sum(s["n_dates_assimilated"] for s in summaries)
+    )
     return stats

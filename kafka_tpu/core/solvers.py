@@ -190,6 +190,7 @@ def iterated_solve(
     state_bounds: Any = None,
     norm_denominator: Any = None,
     hessian_forward: Any = None,
+    linearize_block: Any = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, SolveDiagnostics]:
     """Gauss-Newton relinearisation loop as a single ``lax.while_loop``.
 
@@ -232,9 +233,18 @@ def iterated_solve(
     Returns ``(x_analysis, p_inv_analysis, diagnostics)``.
     """
     numel = x_forecast.size if norm_denominator is None else norm_denominator
+    n_pix_total = x_forecast.shape[0]
+    use_block = (
+        linearize_block is not None and 0 < linearize_block < n_pix_total
+    )
 
     def one_solve(x_prev):
-        lin = _call_linearize(linearize, operator_params, x_prev)
+        if use_block:
+            lin = _blocked_linearize(
+                linearize, operator_params, x_prev, int(linearize_block)
+            )
+        else:
+            lin = _call_linearize(linearize, operator_params, x_prev)
         x_new, a = kalman_update(lin, obs, x_prev, x_forecast, p_inv_forecast)
         return x_new, a, lin
 
@@ -337,7 +347,99 @@ def _call_linearize(linearize, operator_params, x):
     return linearize(x)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 6))
+def _blocked_linearize(linearize, operator_params, x, block: int):
+    """Linearize in sequential pixel blocks (``lax.map``) to bound peak
+    device memory.
+
+    The batched value+Jacobian of a deep operator (the exact-SAIL PROSAIL
+    chain) is the solver's dominant memory consumer — ~11 KB/pixel of live
+    intermediates at p=10, which caps a 16 GB chip near 1.4M pixels per
+    solve.  Mapping the linearisation over blocks makes peak memory
+    ``O(block)`` instead of ``O(n_pix)`` while the cheap normal-equations
+    update still runs over the full batch; per-pixel aux leaves (leading
+    ``n_pix`` axis, e.g. SAR incidence angles) are split alongside the
+    pixels, broadcast leaves close over.
+
+    ``block`` is a maximum: pixels are split into the fewest blocks that
+    respect it, sized evenly, so edge-padding waste is at most one block's
+    remainder instead of up to ~2x.
+
+    Which aux leaves are per-pixel is decided by the OPERATOR when
+    ``linearize`` is a bound ``ObservationModel.linearize`` (its
+    ``aux_in_axes`` honours ``aux_per_pixel = False`` — weight matrices
+    whose leading dim happens to equal ``n_pix`` must not be split);
+    plain closures fall back to the leading-axis heuristic.
+    """
+    n_pix, p = x.shape
+    n_blocks = -(-n_pix // block)
+    block = -(-n_pix // n_blocks)  # even split under the same memory bound
+    n_pad = n_blocks * block - n_pix
+    x_pad = jnp.pad(x, ((0, n_pad), (0, 0)), mode="edge")
+
+    leaves, treedef = jax.tree.flatten(operator_params)
+
+    owner = getattr(linearize, "__self__", None)
+    if owner is not None and hasattr(owner, "aux_in_axes"):
+        # flatten_up_to aligns the operator's in_axes tree (0 = mapped,
+        # None = broadcast) with the param leaves position by position.
+        axes = treedef.flatten_up_to(
+            owner.aux_in_axes(operator_params, n_pix)
+        )
+        per_pixel_flags = [a == 0 for a in axes]
+    else:
+        per_pixel_flags = [
+            (hasattr(leaf, "ndim") and leaf.ndim > 0
+             and leaf.shape[0] == n_pix)
+            for leaf in leaves
+        ]
+    mapped_idx = [i for i, f in enumerate(per_pixel_flags) if f]
+    mapped = [
+        jnp.pad(
+            jnp.asarray(leaves[i]),
+            ((0, n_pad),) + ((0, 0),) * (leaves[i].ndim - 1),
+            mode="edge",
+        ).reshape((n_blocks, block) + leaves[i].shape[1:])
+        for i in mapped_idx
+    ]
+
+    def body(xs):
+        xb = xs[0]
+        ls = list(leaves)
+        for i, leaf_b in zip(mapped_idx, xs[1:]):
+            ls[i] = leaf_b
+        lin = _call_linearize(
+            linearize, jax.tree.unflatten(treedef, ls), xb
+        )
+        return lin.h0, lin.jac
+
+    h0s, jacs = jax.lax.map(
+        body, (x_pad.reshape(n_blocks, block, p), *mapped)
+    )
+    n_bands = h0s.shape[1]
+    h0 = jnp.moveaxis(h0s, 0, 1).reshape(n_bands, n_blocks * block)
+    jac = jnp.moveaxis(jacs, 0, 1).reshape(n_bands, n_blocks * block, p)
+    return Linearization(h0=h0[:, :n_pix], jac=jac[:, :n_pix])
+
+
+@functools.partial(jax.jit, static_argnums=(0, 6, 7))
+def _assimilate_date_impl(
+    linearize: LinearizeFn,
+    obs: BandBatch,
+    x_forecast: jnp.ndarray,
+    p_inv_forecast: jnp.ndarray,
+    operator_params: Any,
+    solver_options: Any,
+    hessian_forward: Any,
+    linearize_block: Any,
+):
+    opts = dict(solver_options or {})
+    return iterated_solve(
+        linearize, obs, x_forecast, p_inv_forecast, operator_params,
+        hessian_forward=hessian_forward, linearize_block=linearize_block,
+        **opts
+    )
+
+
 def assimilate_date_jit(
     linearize: LinearizeFn,
     obs: BandBatch,
@@ -354,9 +456,15 @@ def assimilate_date_jit(
     configuration and feed all per-date data through ``operator_params``
     (a traced pytree) — a fresh closure per date would recompile the whole
     multi-iteration program every timestep.
+
+    Numeric solver options (tol, relaxation, bounds...) flow through as
+    traced values; the structural ``linearize_block`` option (it changes
+    the compiled program's shape) is split out as a static argument here.
     """
     opts = dict(solver_options or {})
-    return iterated_solve(
+    block = opts.pop("linearize_block", None)
+    return _assimilate_date_impl(
         linearize, obs, x_forecast, p_inv_forecast, operator_params,
-        hessian_forward=hessian_forward, **opts
+        opts or None, hessian_forward,
+        None if block is None else int(block),
     )

@@ -50,7 +50,15 @@ def _operator_registry() -> Dict[str, Callable]:
         "wcm": lambda cfg: WCMOperator(),
         "prosail": lambda cfg: _make_prosail(cfg),
         "kernels": lambda cfg: _make_kernels(cfg),
+        "prosail_joint": lambda cfg: _joint_op("ProsailJointOperator"),
+        "wcm_joint": lambda cfg: _joint_op("WCMJointOperator"),
     }
+
+
+def _joint_op(name):
+    from ..obsops import joint
+
+    return getattr(joint, name)()
 
 
 def _make_kernels(cfg):
@@ -72,7 +80,7 @@ def _make_prosail(cfg):
 
 
 def _named_prior(name: Optional[str], cfg: Optional["RunConfig"] = None):
-    from .priors import jrc_prior, kernels_prior, sail_prior
+    from .priors import jrc_prior, joint_prior, kernels_prior, sail_prior
 
     if name is None:
         return None
@@ -92,6 +100,7 @@ def _named_prior(name: Optional[str], cfg: Optional["RunConfig"] = None):
         "tip": jrc_prior,
         "jrc": jrc_prior,
         "sail": sail_prior,
+        "joint": joint_prior,
     }[name]()
 
 
@@ -199,6 +208,37 @@ class RunConfig:
                 self.data_folder, operator,
                 start_time=self.start, end_time=self.end,
             )
+        if self.observations == "joint":
+            # Multi-sensor S2 optical + S1 SAR on the shared 11-parameter
+            # joint state: data_folder is the S2 granule tree,
+            # extra["s1_folder"] the S1 NetCDF folder.  ``operator`` (the
+            # config's named operator, normally "prosail_joint") serves the
+            # S2 dates; the WCM joint operator serves the S1 dates.
+            from ..io.multi import CompositeObservations
+            from ..io.sentinel1 import S1Observations
+            from ..io.sentinel2 import Sentinel2Observations
+            from ..obsops.joint import WCMJointOperator
+
+            s2 = Sentinel2Observations(
+                self.data_folder, operator, state_geo,
+                aux_builder=aux_builder,
+                relative_uncertainty=self.extra.get(
+                    "relative_uncertainty", 0.05
+                ),
+            )
+            # ONE WCM instance per config: the jitted solver is keyed on
+            # the operator's bound linearize, so a fresh instance per
+            # chunk would recompile the S1 program every chunk.
+            if not hasattr(self, "_wcm_joint_op"):
+                self._wcm_joint_op = WCMJointOperator()
+            s1 = S1Observations(
+                self.extra["s1_folder"], state_geo,
+                operator=self._wcm_joint_op,
+                relative_uncertainty=self.extra.get(
+                    "s1_relative_uncertainty", 0.05
+                ),
+            )
+            return CompositeObservations([s2, s1])
         raise KeyError(
             f"no observation-source factory for {self.observations!r}"
         )

@@ -159,6 +159,12 @@ class KalmanFilter:
                 "norm_denominator",
                 float(self.gather.n_valid * self.n_params),
             )
+            # Bound solver peak memory on big batches: linearise in
+            # sequential 256k-pixel blocks (the batched value+Jacobian is
+            # ~11 KB/px of live intermediates for deep operators — without
+            # blocking, ~1.4M px exhausts a 16 GB chip).
+            if self.gather.n_pad > 262144:
+                opts.setdefault("linearize_block", 262144)
             hess_fwd = None
             if self.hessian_correction:
                 hess_fwd = getattr(obs.operator, "forward_pixel", None)

@@ -104,3 +104,29 @@ def jrc_prior() -> FixedGaussianPrior:
         mean=jnp.asarray(mean), cov=base.cov, inv_cov=base.inv_cov
     )
     return FixedGaussianPrior(prior, TIP_PARAMETER_LIST)
+
+
+# The 11-parameter joint optical+SAR state (obsops.joint).
+JOINT_PARAMETER_LIST = PROSAIL_PARAMETER_LIST + ("sm",)
+
+
+def joint_prior() -> FixedGaussianPrior:
+    """Prior for the joint S2+S1 state: the SAIL prior extended with a
+    broad volumetric soil-moisture marginal (mean 0.25 m^3/m^3, sigma
+    0.15 — essentially uninformative over the WCM domain, so soil
+    moisture is learned from the SAR signal)."""
+    base = sail_prior().prior
+    mean = np.concatenate(
+        [np.asarray(base.mean), [0.25]]
+    ).astype(np.float32)
+    cov = np.zeros((11, 11), np.float32)
+    cov[:10, :10] = np.asarray(base.cov)
+    cov[10, 10] = 0.15**2
+    inv_cov = np.zeros((11, 11), np.float32)
+    inv_cov[:10, :10] = np.asarray(base.inv_cov)
+    inv_cov[10, 10] = 1.0 / 0.15**2
+    prior = PixelPrior(
+        mean=jnp.asarray(mean), cov=jnp.asarray(cov),
+        inv_cov=jnp.asarray(inv_cov),
+    )
+    return FixedGaussianPrior(prior, JOINT_PARAMETER_LIST)

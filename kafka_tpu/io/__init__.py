@@ -1,8 +1,17 @@
 """Host-side raster I/O: GeoTIFF codec, warping, sensor readers, output
 writers, chunk tiling."""
 
-from .geotiff import GeoInfo, TiffInfo, read_geotiff, read_info, write_geotiff
+from .geotiff import (
+    GeoInfo,
+    TiffInfo,
+    TiledTiffWriter,
+    read_geotiff,
+    read_geotiff_window,
+    read_info,
+    write_geotiff,
+)
 from .mod09 import MOD09Observations, decode_state_qa, zoom2_nearest
+from .multi import CompositeObservations
 from .modis import BHRObservations, SynergyKernels
 from .output import GeoTIFFOutput
 from .sentinel1 import S1Observations

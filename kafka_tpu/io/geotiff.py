@@ -91,47 +91,53 @@ class TiffInfo:
 
 
 # --- reading --------------------------------------------------------------
+#
+# All parsing is seek-based: only the header, the IFD, and the out-of-line
+# tag values are read up front, so opening a multi-GB BigTIFF costs a few KB
+# of I/O and windowed reads touch only the tiles they intersect.
 
 
-def _read_ifd(buf, offset, endian, big):
+def _read_ifd(read, offset, endian, big):
+    """Parse one IFD via ``read(offset, size) -> bytes``."""
     entries = {}
     if big:
-        (count,) = struct.unpack_from(endian + "Q", buf, offset)
+        (count,) = struct.unpack(endian + "Q", read(offset, 8))
         pos = offset + 8
         entry_size, cnt_fmt = 20, "Q"
     else:
-        (count,) = struct.unpack_from(endian + "H", buf, offset)
+        (count,) = struct.unpack(endian + "H", read(offset, 2))
         pos = offset + 2
         entry_size, cnt_fmt = 12, "I"
+    block = read(pos, count * entry_size + (8 if big else 4))
     for i in range(count):
-        tag, typ = struct.unpack_from(endian + "HH", buf, pos)
-        (n,) = struct.unpack_from(endian + cnt_fmt, buf, pos + 4)
-        val_off = pos + (12 if big else 8)
+        epos = i * entry_size
+        tag, typ = struct.unpack_from(endian + "HH", block, epos)
+        (n,) = struct.unpack_from(endian + cnt_fmt, block, epos + 4)
+        val_off = epos + (12 if big else 8)
         size = _TYPE_SIZES.get(typ, 1) * n
         inline = 8 if big else 4
         if size <= inline:
-            data_pos = val_off
+            data = block[val_off:val_off + size]
         else:
             (data_pos,) = struct.unpack_from(
-                endian + ("Q" if big else "I"), buf, val_off
+                endian + ("Q" if big else "I"), block, val_off
             )
+            data = read(data_pos, size)
         if typ in (2, 7):  # ascii / undefined
-            values = bytes(buf[data_pos:data_pos + n])
+            values = bytes(data[:n])
         elif typ == 5 or typ == 10:  # rational
-            raw = struct.unpack_from(endian + ("iI"[typ == 5] * 2 * n),
-                                     buf, data_pos)
+            raw = struct.unpack(endian + ("iI"[typ == 5] * 2 * n), data)
             values = tuple(raw[2 * i] / max(raw[2 * i + 1], 1)
                            for i in range(n))
         else:
             fmt = _TYPE_FMT.get(typ)
             if fmt is None:
-                pos += entry_size
                 continue
-            values = struct.unpack_from(endian + fmt * n, buf, data_pos)
+            values = struct.unpack(endian + fmt * n, data)
         entries[tag] = values
-        pos += entry_size
-    (next_ifd,) = struct.unpack_from(
-        endian + ("Q" if big else "I"), buf, pos
+    (next_ifd,) = struct.unpack(
+        endian + ("Q" if big else "I"),
+        block[count * entry_size:count * entry_size + (8 if big else 4)],
     )
     return entries, next_ifd
 
@@ -144,25 +150,30 @@ def _tag1(tags, tag, default=None):
 
 
 def read_info(path: str) -> TiffInfo:
+    """Header + IFD only — cheap even for multi-GB files."""
     with open(path, "rb") as f:
-        buf = f.read()
-    return _parse_info(buf)[0]
+        return _parse_info_f(f)[0]
 
 
-def _parse_info(buf):
-    endian = {b"II": "<", b"MM": ">"}.get(bytes(buf[:2]))
+def _parse_info_f(f):
+    def read(off, size):
+        f.seek(off)
+        return f.read(size)
+
+    head = read(0, 16)
+    endian = {b"II": "<", b"MM": ">"}.get(bytes(head[:2]))
     if endian is None:
         raise ValueError("not a TIFF file")
-    magic = struct.unpack_from(endian + "H", buf, 2)[0]
+    magic = struct.unpack_from(endian + "H", head, 2)[0]
     if magic == 42:
         big = False
-        (ifd_off,) = struct.unpack_from(endian + "I", buf, 4)
+        (ifd_off,) = struct.unpack_from(endian + "I", head, 4)
     elif magic == 43:
         big = True
-        (ifd_off,) = struct.unpack_from(endian + "Q", buf, 8)
+        (ifd_off,) = struct.unpack_from(endian + "Q", head, 8)
     else:
         raise ValueError("bad TIFF magic %d" % magic)
-    tags, _ = _read_ifd(buf, ifd_off, endian, big)
+    tags, _ = _read_ifd(read, ifd_off, endian, big)
 
     width = _tag1(tags, T_WIDTH)
     height = _tag1(tags, T_HEIGHT)
@@ -215,20 +226,27 @@ def _parse_info(buf):
 
 def _decode_segments(segments, info, seg_shape):
     """Decompress + de-predict a list of raw byte segments into arrays of
-    ``seg_shape`` (rows, cols, bands)."""
+    ``seg_shape`` (rows, cols, bands).  Empty segments (sparse-file tiles,
+    offset/bytecount 0) decode to zeros."""
     rows, cols = seg_shape
     itemsize = info.dtype.itemsize
     expected = rows * cols * info.n_bands * itemsize
+    present = [(i, s) for i, s in enumerate(segments) if len(s)]
     if info.compression in (8, 32946):
-        raw = native_codec.inflate_many(segments, expected)
+        raw_present = native_codec.inflate_many(
+            [s for _, s in present], expected
+        )
     elif info.compression == 1:
-        raw = [bytes(s) for s in segments]
+        raw_present = [bytes(s) for _, s in present]
     elif info.compression == 5:
-        raw = [_lzw_decode(bytes(s)) for s in segments]
+        raw_present = [_lzw_decode(bytes(s)) for _, s in present]
     else:
         raise NotImplementedError(
             "TIFF compression %d not supported" % info.compression
         )
+    raw = [b""] * len(segments)
+    for (i, _), r in zip(present, raw_present):
+        raw[i] = r
     # Decode with the FILE's byte order, then return native-endian arrays.
     file_dtype = info.dtype.newbyteorder(info.byte_order)
     out = []
@@ -288,44 +306,104 @@ def _lzw_decode(data: bytes) -> bytes:
 
 
 def read_geotiff(path: str) -> Tuple[np.ndarray, TiffInfo]:
-    """Read a GeoTIFF.  Returns ``(array, info)`` with array shaped
+    """Read a whole GeoTIFF.  Returns ``(array, info)`` with array shaped
     (height, width) single-band or (height, width, bands)."""
     with open(path, "rb") as f:
-        buf = f.read()
-    info, endian, big = _parse_info(buf)
+        info, _, _ = _parse_info_f(f)
+        arr = _read_window_f(f, info, 0, 0, info.height, info.width)
+    return arr, info
+
+
+def read_geotiff_window(path: str, row0: int, col0: int, nrows: int,
+                        ncols: int, info: Optional[TiffInfo] = None,
+                        ) -> Tuple[np.ndarray, TiffInfo]:
+    """Read only the pixels of a window — decodes just the tiles/strips it
+    intersects, so reading a 256x256 chunk of a 10980x10980 BigTIFF costs
+    window-sized I/O instead of a whole-file decode (the streaming-read
+    half of the reference's ``gdal.Translate(srcWin=...)`` /
+    ``gdal.Warp`` usage, ``kafka_test_S2.py:155-158``).
+
+    The window may extend past the raster edge; out-of-raster pixels come
+    back zero-filled.  Pass a previously obtained ``info`` (``read_info``)
+    to skip re-parsing the header/IFD on repeated windows of one file.
+    Returns ``(array, info)`` with array shaped ``(nrows, ncols[, bands])``."""
+    with open(path, "rb") as f:
+        if info is None:
+            info, _, _ = _parse_info_f(f)
+        arr = _read_window_f(f, info, row0, col0, nrows, ncols)
+    return arr, info
+
+
+def _read_window_f(f, info: TiffInfo, row0: int, col0: int, nrows: int,
+                   ncols: int) -> np.ndarray:
     tags = info.tags
     h, w, nb = info.height, info.width, info.n_bands
-    out = np.zeros((h, w, nb), info.dtype)
+    out = np.zeros((nrows, ncols, nb), info.dtype)
+
+    def read_seg(off, cnt):
+        if cnt == 0 or off == 0:
+            return b""
+        f.seek(off)
+        return f.read(cnt)
+
     if info.tiled:
         th, tw = info.tile_shape
         offsets = tags[T_TILE_OFFSETS]
         counts = tags[T_TILE_BYTECOUNTS]
         tiles_across = (w + tw - 1) // tw
-        segs = [buf[o:o + c] for o, c in zip(offsets, counts)]
+        tiles_down = (h + th - 1) // th
+        ty0 = max(0, row0 // th)
+        ty1 = min(tiles_down, (row0 + nrows + th - 1) // th)
+        tx0 = max(0, col0 // tw)
+        tx1 = min(tiles_across, (col0 + ncols + tw - 1) // tw)
+        wanted = [
+            ty * tiles_across + tx
+            for ty in range(ty0, ty1) for tx in range(tx0, tx1)
+        ]
+        segs = [read_seg(offsets[i], counts[i]) for i in wanted]
         arrays = _decode_segments(segs, info, (th, tw))
-        for idx, arr in enumerate(arrays):
+        for idx, arr in zip(wanted, arrays):
             ty, tx = divmod(idx, tiles_across)
             y0, x0 = ty * th, tx * tw
-            ys, xs = min(th, h - y0), min(tw, w - x0)
-            if ys <= 0 or xs <= 0:
+            # overlap of this tile with the window, in window coords
+            oy0 = max(y0, row0)
+            oy1 = min(y0 + th, row0 + nrows, h)
+            ox0 = max(x0, col0)
+            ox1 = min(x0 + tw, col0 + ncols, w)
+            if oy1 <= oy0 or ox1 <= ox0:
                 continue
-            out[y0:y0 + ys, x0:x0 + xs] = arr[:ys, :xs]
+            out[oy0 - row0:oy1 - row0, ox0 - col0:ox1 - col0] = (
+                arr[oy0 - y0:oy1 - y0, ox0 - x0:ox1 - x0]
+            )
     else:
         rps = int(_tag1(tags, T_ROWS_PER_STRIP, h))
         offsets = tags[T_STRIP_OFFSETS]
-        counts = tags.get(
-            T_STRIP_BYTECOUNTS, tuple([len(buf)] * len(offsets))
-        )
-        for si, (o, c) in enumerate(zip(offsets, counts)):
+        counts = tags.get(T_STRIP_BYTECOUNTS, (None,) * len(offsets))
+        s0 = max(0, row0 // rps)
+        s1 = min(len(offsets), (row0 + nrows + rps - 1) // rps)
+        for si in range(s0, s1):
+            o = offsets[si]
+            c = counts[si]
+            if c is None:
+                f.seek(0, 2)
+                c = f.tell() - o
             y0 = si * rps
             rows = min(rps, h - y0)
             if rows <= 0:
                 continue
-            arr = _decode_segments([buf[o:o + c]], info, (rows, w))[0]
-            out[y0:y0 + rows] = arr
+            arr = _decode_segments([read_seg(o, c)], info, (rows, w))[0]
+            oy0 = max(y0, row0)
+            oy1 = min(y0 + rows, row0 + nrows)
+            ox0 = max(col0, 0)
+            ox1 = min(w, col0 + ncols)
+            if oy1 <= oy0 or ox1 <= ox0:
+                continue
+            out[oy0 - row0:oy1 - row0, ox0 - col0:ox1 - col0] = (
+                arr[oy0 - y0:oy1 - y0, ox0:ox1]
+            )
     if nb == 1:
         out = out[:, :, 0]
-    return out, info
+    return out
 
 
 # --- writing --------------------------------------------------------------
@@ -375,6 +453,205 @@ _DTYPE_TO_TAGS = {
 }
 
 
+class TiledTiffWriter:
+    """Streaming tiled GeoTIFF writer.
+
+    Tiles are compressed and appended to the file as they are produced —
+    nothing accumulates in memory — and the IFD is written at end-of-file
+    on :meth:`close` (the libtiff append layout: the header's IFD pointer
+    is patched last, so a crashed write is detectable as a zero pointer).
+    This is what makes multi-GB BigTIFF tile-year outputs writable from a
+    host that is simultaneously holding the assimilation state.
+
+    Tiles may be written in any order; unwritten tiles become sparse
+    (offset/bytecount 0, reading as zeros — GDAL's sparse-file convention).
+    """
+
+    def __init__(
+        self,
+        path: str,
+        height: int,
+        width: int,
+        n_bands: int = 1,
+        dtype=np.float32,
+        geo: Optional[GeoInfo] = None,
+        tile_size: int = 256,
+        compress: bool = True,
+        level: int = 6,
+        predictor: int = 1,
+        bigtiff: Optional[bool] = None,
+    ):
+        self.h, self.w, self.nb = int(height), int(width), int(n_bands)
+        self.dtype = np.dtype(dtype)
+        if self.dtype not in _DTYPE_TO_TAGS:
+            raise ValueError(f"unsupported sample dtype {self.dtype}")
+        if predictor == 2 and self.dtype.kind == "f":
+            # TIFF predictor 2 is integer-only (floats use predictor 3); a
+            # float-diff file would be unreadable by libtiff/GDAL.
+            raise ValueError(
+                "predictor=2 requires an integer dtype; floats must use "
+                "predictor 1 (got %s)" % self.dtype
+            )
+        self.geo = geo or GeoInfo()
+        self.ts = int(tile_size)
+        self.compress = bool(compress)
+        self.level = int(level)
+        self.predictor = int(predictor)
+        self.tiles_down = (self.h + self.ts - 1) // self.ts
+        self.tiles_across = (self.w + self.ts - 1) // self.ts
+        n_tiles = self.tiles_down * self.tiles_across
+        raw_size = self.h * self.w * self.nb * self.dtype.itemsize
+        if bigtiff is None:
+            bigtiff = raw_size > 3_500_000_000
+        self.big = bool(bigtiff)
+        self._offsets = [0] * n_tiles
+        self._counts = [0] * n_tiles
+        self._f = open(path, "wb")
+        # Header with a zero IFD pointer (patched on close).
+        if self.big:
+            self._f.write(struct.pack("<2sHHHQ", b"II", 43, 8, 0, 0))
+        else:
+            self._f.write(struct.pack("<2sHI", b"II", 42, 0))
+        self._pos = self._f.tell()
+        self._closed = False
+
+    def _prep_tile(self, tile: np.ndarray) -> bytes:
+        """Pad to the tile grid + apply the predictor; returns raw bytes."""
+        arr = np.asarray(tile)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        full = np.zeros((self.ts, self.ts, self.nb), self.dtype)
+        full[:arr.shape[0], :arr.shape[1]] = arr.astype(self.dtype)
+        if self.predictor == 2:
+            full = np.diff(
+                np.concatenate(
+                    [np.zeros((self.ts, 1, self.nb), self.dtype), full],
+                    axis=1,
+                ),
+                axis=1,
+            ).astype(self.dtype)
+        return full.tobytes()
+
+    def _append_segment(self, idx: int, seg: bytes) -> None:
+        if not self.big and self._pos + len(seg) > 0xFFFFFFFF:
+            raise ValueError(
+                "classic TIFF offset overflow — pass bigtiff=True"
+            )
+        self._offsets[idx] = self._pos
+        self._counts[idx] = len(seg)
+        self._f.seek(self._pos)
+        self._f.write(seg)
+        self._pos += len(seg)
+
+    def write_tile(self, ty: int, tx: int, tile: np.ndarray) -> None:
+        """Write one tile (row ``ty``, col ``tx``).  ``tile`` may be the
+        full ``tile_size`` square or the clipped edge shape; it is
+        zero-padded to the tile grid."""
+        if not (0 <= ty < self.tiles_down and 0 <= tx < self.tiles_across):
+            raise IndexError(f"tile ({ty}, {tx}) outside grid")
+        seg = self._prep_tile(tile)
+        if self.compress:
+            seg = native_codec.deflate_many([seg], self.level)[0]
+        self._append_segment(ty * self.tiles_across + tx, seg)
+
+    def write_rows(self, row0: int, rows: np.ndarray) -> None:
+        """Write a horizontal band of complete tile rows starting at pixel
+        row ``row0`` (must be tile-aligned and a multiple of ``tile_size``
+        tall, except the last band).  All tiles of the band go through ONE
+        batched deflate call so the native codec's thread pool gets the
+        whole row at once."""
+        if row0 % self.ts:
+            raise ValueError("row0 must be tile-aligned")
+        ty0 = row0 // self.ts
+        arr = np.asarray(rows)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        indices, raws = [], []
+        for dy in range(0, arr.shape[0], self.ts):
+            for tx in range(self.tiles_across):
+                x0 = tx * self.ts
+                indices.append((ty0 + dy // self.ts) * self.tiles_across + tx)
+                raws.append(
+                    self._prep_tile(arr[dy:dy + self.ts, x0:x0 + self.ts])
+                )
+        segs = (native_codec.deflate_many(raws, self.level)
+                if self.compress else raws)
+        for idx, seg in zip(indices, segs):
+            self._append_segment(idx, seg)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        bits, fmt = _DTYPE_TO_TAGS[self.dtype]
+        off_type = 16 if self.big else 4  # LONG8 vs LONG
+        entries = [
+            (T_WIDTH, 3, (self.w,)), (T_HEIGHT, 3, (self.h,)),
+            (T_BITS, 3, (bits,) * self.nb),
+            (T_COMPRESSION, 3, (8 if self.compress else 1,)),
+            (T_PHOTOMETRIC, 3, (1,)),
+            (T_SAMPLES_PER_PIXEL, 3, (self.nb,)),
+            (T_PLANAR, 3, (1,)),
+            (T_PREDICTOR, 3, (self.predictor,)),
+            (T_TILE_WIDTH, 3, (self.ts,)), (T_TILE_HEIGHT, 3, (self.ts,)),
+            (T_SAMPLE_FORMAT, 3, (fmt,) * self.nb),
+            (T_TILE_OFFSETS, off_type, tuple(self._offsets)),
+            (T_TILE_BYTECOUNTS, off_type, tuple(self._counts)),
+        ]
+        entries += _geo_tags(self.geo)
+        entries.sort(key=lambda e: e[0])
+        endian = "<"
+        inline_max = 8 if self.big else 4
+        ifd_entry = 20 if self.big else 12
+
+        def value_bytes(typ, values):
+            if typ == 2 or typ == 7:
+                return bytes(values)
+            fmt_ch = {3: "H", 4: "I", 12: "d", 16: "Q"}[typ]
+            return struct.pack(endian + fmt_ch * len(values), *values)
+
+        ifd_start = (self._pos + 1) & ~1
+        n = len(entries)
+        ifd_size = (8 if self.big else 2) + n * ifd_entry + \
+            (8 if self.big else 4)
+        ov_pos = ifd_start + ifd_size
+        if not self.big and ov_pos > 0xFFFFFFFF:
+            raise ValueError(
+                "classic TIFF offset overflow — pass bigtiff=True"
+            )
+        f = self._f
+        f.seek(ifd_start)
+        f.write(struct.pack(endian + ("Q" if self.big else "H"), n))
+        ov_chunks = []
+        for tag, typ, values in entries:
+            raw = value_bytes(typ, values)
+            f.write(struct.pack(endian + "HH", tag, typ))
+            f.write(struct.pack(endian + ("Q" if self.big else "I"),
+                                len(values)))
+            if len(raw) <= inline_max:
+                f.write(raw.ljust(inline_max, b"\x00"))
+            else:
+                f.write(struct.pack(endian + ("Q" if self.big else "I"),
+                                    ov_pos))
+                ov_chunks.append((ov_pos, raw))
+                ov_pos += (len(raw) + 1) & ~1
+        f.write(struct.pack(endian + ("Q" if self.big else "I"), 0))
+        for pos_, raw in ov_chunks:
+            f.seek(pos_)
+            f.write(raw)
+        # Patch the header's IFD pointer last: a file with a zero pointer
+        # is an unfinished write.
+        f.seek(8 if self.big else 4)
+        f.write(struct.pack(endian + ("Q" if self.big else "I"), ifd_start))
+        f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 def write_geotiff(
     path: str,
     array: np.ndarray,
@@ -389,149 +666,19 @@ def write_geotiff(
     writer-side contract of the reference's ``KafkaOutput``
     (``observations.py:360-365``: COMPRESS=DEFLATE, TILED=YES, PREDICTOR=1,
     BIGTIFF=YES; BigTIFF here switches on automatically past 3.5 GB or can
-    be forced)."""
-    geo = geo or GeoInfo()
+    be forced).  Streams through :class:`TiledTiffWriter` tile-row by
+    tile-row, so peak memory is one row of compressed tiles, not the whole
+    file."""
     arr = np.asarray(array)
     if arr.ndim == 2:
         arr = arr[:, :, None]
-    h, w, nb = arr.shape
-    dtype = arr.dtype
-    if dtype not in _DTYPE_TO_TAGS:
+    if arr.dtype not in _DTYPE_TO_TAGS:
         arr = arr.astype(np.float32)
-        dtype = arr.dtype
-    bits, fmt = _DTYPE_TO_TAGS[dtype]
-    if predictor == 2 and dtype.kind == "f":
-        # TIFF predictor 2 is integer-only (floats use predictor 3); a
-        # float-diff file would be unreadable by libtiff/GDAL.
-        raise ValueError(
-            "predictor=2 requires an integer dtype; floats must use "
-            "predictor 1 (got %s)" % dtype
-        )
-
-    th = tw = tile_size
-    tiles_down = (h + th - 1) // th
-    tiles_across = (w + tw - 1) // tw
-    segs = []
-    for ty in range(tiles_down):
-        for tx in range(tiles_across):
-            tile = np.zeros((th, tw, nb), dtype)
-            y0, x0 = ty * th, tx * tw
-            ys, xs = min(th, h - y0), min(tw, w - x0)
-            tile[:ys, :xs] = arr[y0:y0 + ys, x0:x0 + xs]
-            if predictor == 2:
-                tile = np.diff(
-                    np.concatenate(
-                        [np.zeros((th, 1, nb), dtype), tile], axis=1
-                    ),
-                    axis=1,
-                ).astype(dtype)
-            segs.append(tile.tobytes())
-    if compress:
-        segs = native_codec.deflate_many(segs, level)
-        compression = 8
-    else:
-        compression = 1
-
-    data_size = sum(len(s) for s in segs)
-    if bigtiff is None:
-        bigtiff = data_size > 3_500_000_000
-    big = bool(bigtiff)
-
-    entries = [
-        (T_WIDTH, 3, (w,)), (T_HEIGHT, 3, (h,)),
-        (T_BITS, 3, (bits,) * nb),
-        (T_COMPRESSION, 3, (compression,)),
-        (T_PHOTOMETRIC, 3, (1,)),
-        (T_SAMPLES_PER_PIXEL, 3, (nb,)),
-        (T_PLANAR, 3, (1,)),
-        (T_PREDICTOR, 3, (predictor,)),
-        (T_TILE_WIDTH, 3, (tw,)), (T_TILE_HEIGHT, 3, (th,)),
-        (T_SAMPLE_FORMAT, 3, (fmt,) * nb),
-    ]
-    entries += _geo_tags(geo)
-
-    off_type = 16 if big else 4  # LONG8 vs LONG
-    entries.append((T_TILE_OFFSETS, off_type, None))     # patched later
-    entries.append((T_TILE_BYTECOUNTS, off_type, None))
-    entries.sort(key=lambda e: e[0])
-
-    endian = "<"
-    header_size = 16 if big else 8
-    ifd_entry = 20 if big else 12
-    ifd_header = 8 if big else 2
-    ifd_tail = 8 if big else 4
-    inline_max = 8 if big else 4
-    n = len(entries)
-    ifd_size = ifd_header + n * ifd_entry + ifd_tail
-
-    # layout: header | IFD | overflow tag data | segment data
-    overflow = []
-    overflow_pos = header_size + ifd_size
-
-    def value_bytes(typ, values):
-        if typ == 2 or typ == 7:
-            return bytes(values)
-        fmt_ch = {3: "H", 4: "I", 12: "d", 16: "Q"}[typ]
-        return struct.pack(endian + fmt_ch * len(values), *values)
-
-    # first pass to size overflow area (tile offsets resolved after)
-    seg_count = len(segs)
-    placeholder = {
-        T_TILE_OFFSETS: (off_type, tuple([0] * seg_count)),
-        T_TILE_BYTECOUNTS: (off_type, tuple(len(s) for s in segs)),
-    }
-    sized = []
-    for tag, typ, values in entries:
-        if values is None:
-            typ, values = placeholder[tag]
-        raw = value_bytes(typ, values)
-        count = (
-            len(values) if typ in (2, 7)
-            else len(values)
-        )
-        sized.append((tag, typ, count, raw))
-        if len(raw) > inline_max:
-            overflow.append(len(raw))
-    data_start = overflow_pos + sum((s + 1) & ~1 for s in overflow)
-
-    # resolve real tile offsets
-    offsets = []
-    pos = data_start
-    for s in segs:
-        offsets.append(pos)
-        pos += len(s)
-    final = []
-    for tag, typ, count, raw in sized:
-        if tag == T_TILE_OFFSETS:
-            raw = value_bytes(typ, tuple(offsets))
-        final.append((tag, typ, count, raw))
-
-    with open(path, "wb") as f:
-        if big:
-            f.write(struct.pack(endian + "2sHHHQ", b"II", 43, 8, 0,
-                                header_size))
-        else:
-            f.write(struct.pack(endian + "2sHI", b"II", 42, header_size))
-        # IFD
-        if big:
-            f.write(struct.pack(endian + "Q", n))
-        else:
-            f.write(struct.pack(endian + "H", n))
-        ov_pos = overflow_pos
-        ov_chunks = []
-        for tag, typ, count, raw in final:
-            f.write(struct.pack(endian + "HH", tag, typ))
-            f.write(struct.pack(endian + ("Q" if big else "I"), count))
-            if len(raw) <= inline_max:
-                f.write(raw.ljust(inline_max, b"\x00"))
-            else:
-                f.write(struct.pack(endian + ("Q" if big else "I"), ov_pos))
-                ov_chunks.append((ov_pos, raw))
-                ov_pos += (len(raw) + 1) & ~1
-        f.write(struct.pack(endian + ("Q" if big else "I"), 0))  # next IFD
-        for pos_, raw in ov_chunks:
-            f.seek(pos_)
-            f.write(raw)
-        f.seek(data_start)
-        for s in segs:
-            f.write(s)
+    h, w, nb = arr.shape
+    with TiledTiffWriter(
+        path, h, w, n_bands=nb, dtype=arr.dtype, geo=geo,
+        tile_size=tile_size, compress=compress, level=level,
+        predictor=predictor, bigtiff=bigtiff,
+    ) as writer:
+        for y0 in range(0, h, tile_size):
+            writer.write_rows(y0, arr[y0:y0 + tile_size])

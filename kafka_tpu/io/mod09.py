@@ -44,7 +44,7 @@ from ..core.types import BandBatch
 from ..engine.protocols import DateObservation
 from ..engine.state import PixelGather
 from ..obsops.kernels import KernelsAux, ross_li_kernels
-from .geotiff import read_geotiff
+from .geotiff import read_geotiff, read_info
 from .roi import RoiWindowMixin, index_dated_paths
 
 LOG = logging.getLogger(__name__)
@@ -127,7 +127,7 @@ class MOD09Observations(RoiWindowMixin):
     def define_output(self):
         self._require_dates()
         granule = self._granules[self.dates[0]]
-        _, info = read_geotiff(os.path.join(granule, "sur_refl_b01.tif"))
+        info = read_info(os.path.join(granule, "sur_refl_b01.tif"))
         gt = self._shift_geotransform(info.geo.geotransform)
         return info.geo.epsg or info.geo.projection or "sinusoidal", gt
 
@@ -155,7 +155,14 @@ class MOD09Observations(RoiWindowMixin):
 
         ys, r_invs, masks = [], [], []
         for band in range(7):
-            dn = self._window(self._read(granule, f"sur_refl_b{band + 1:02d}"))
+            # 500 m bands are the I/O bulk: read only the ROI window.  The
+            # 1 km QA/angle rasters above stay whole-raster (1/4 the pixels,
+            # and the x2 zoom needs the full grid alignment).
+            dn = np.asarray(
+                self._read_windowed(
+                    os.path.join(granule, f"sur_refl_b{band + 1:02d}.tif")
+                )
+            ).squeeze()
             refl = dn.astype(np.float32) / 10000.0
             refl_pix = gather.gather(refl)
             valid = clear_pix & np.isfinite(refl_pix) & (refl_pix > 0)

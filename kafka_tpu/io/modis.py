@@ -39,7 +39,7 @@ import numpy as np
 from ..core.types import BandBatch
 from ..engine.protocols import DateObservation
 from ..engine.state import PixelGather
-from .geotiff import read_geotiff
+from .geotiff import read_geotiff, read_info
 from .roi import RoiWindowMixin, index_dated_paths
 
 LOG = logging.getLogger(__name__)
@@ -103,7 +103,7 @@ class BHRObservations(RoiWindowMixin):
     def define_output(self):
         self._require_dates()
         kpath, _ = self._paths(self.dates[0], 0)
-        _, info = read_geotiff(kpath)
+        info = read_info(kpath)
         gt = self._shift_geotransform(info.geo.geotransform)
         return info.geo.epsg or "sinusoidal", gt
 
@@ -111,10 +111,10 @@ class BHRObservations(RoiWindowMixin):
         ys, r_invs, masks = [], [], []
         for band in (0, 1):
             kpath, qpath = self._paths(date, band)
-            kernels, _ = read_geotiff(kpath)     # (ny, nx, 3)
-            qa, _ = read_geotiff(qpath)
-            kernels = self._window(np.asarray(kernels, np.float64))
-            qa = self._window(np.asarray(qa))
+            kernels = np.asarray(
+                self._read_windowed(kpath), np.float64
+            )  # (ny, nx, 3)
+            qa = np.asarray(self._read_windowed(qpath))
             k_pix = gather.gather(kernels)       # (n_pad, 3)
             qa_pix = gather.gather(qa.astype(np.int32), fill=255)
             valid = (qa_pix <= 1) & np.isfinite(k_pix).all(axis=-1) \
@@ -196,26 +196,26 @@ class SynergyKernels(RoiWindowMixin):
     def define_output(self):
         self._require_dates()
         stem = self._stems[self.dates[0]]
-        _, info = read_geotiff(stem + "_b0_kernel_weights.tif")
+        info = read_info(stem + "_b0_kernel_weights.tif")
         gt = self._shift_geotransform(info.geo.geotransform)
         return info.geo.epsg or info.geo.projection or "sinusoidal", gt
 
     def get_observations(self, date, gather: PixelGather) -> DateObservation:
         stem = self._stems[date]
-        mask_r, _ = read_geotiff(stem + "_mask.tif")
+        mask_r = self._read_windowed(stem + "_mask.tif")
         usable = gather.gather(
-            self._window(np.asarray(mask_r).squeeze().astype(bool))
+            np.asarray(mask_r).squeeze().astype(bool)
         ) & gather.valid
 
         bhr = np.zeros((7, gather.n_pad), np.float64)
         var = np.zeros((7, gather.n_pad), np.float64)
         for band in range(7):
-            k, _ = read_geotiff(f"{stem}_b{band}_kernel_weights.tif")
-            u, _ = read_geotiff(f"{stem}_b{band}_kernel_unc.tif")
+            k = self._read_windowed(f"{stem}_b{band}_kernel_weights.tif")
+            u = self._read_windowed(f"{stem}_b{band}_kernel_unc.tif")
             k_pix = gather.gather(
-                self._window(np.asarray(k, np.float64))
+                np.asarray(k, np.float64)
             )  # (n_pad, 3)
-            u_pix = gather.gather(self._window(np.asarray(u, np.float64)))
+            u_pix = gather.gather(np.asarray(u, np.float64))
             bhr[band] = k_pix @ TO_BHR
             var[band] = (u_pix**2) @ (TO_BHR**2)
 

@@ -1,0 +1,58 @@
+"""Multi-sensor observation composition.
+
+The reference assimilates one sensor per driver; combining optical and SAR
+time series over one state is left undone (its SAR operator exists but no
+driver wires it, ``/root/reference/kafka/observation_operators/
+sar_forward_model.py``).  ``CompositeObservations`` merges any number of
+``ObservationSource``s into one: the date list is the sorted union, and
+each date dispatches to the source that owns it — the per-date
+``DateObservation`` carries that sensor's own operator and aux, which the
+engine already supports (one jitted program per operator, reused across
+its dates).
+
+Same-day acquisitions from different sensors are kept distinct by nudging
+later sources' duplicate dates forward by one second per source index
+(real S1/S2 acquisition timestamps differ anyway; the reference keys
+observations by exact datetime too, ``linear_kf.py:225-227``).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any, Dict, List, Sequence
+
+from ..engine.protocols import DateObservation
+from ..engine.state import PixelGather
+
+
+class CompositeObservations:
+    """One ObservationSource over several sensors."""
+
+    def __init__(self, sources: Sequence[Any]):
+        if not sources:
+            raise ValueError("CompositeObservations needs >= 1 source")
+        self.sources = list(sources)
+        self._owner: Dict[datetime.datetime, Any] = {}
+        self._source_date: Dict[datetime.datetime, datetime.datetime] = {}
+        for si, src in enumerate(self.sources):
+            for d in src.dates:
+                key = d
+                while key in self._owner:
+                    key = key + datetime.timedelta(seconds=si + 1)
+                self._owner[key] = src
+                self._source_date[key] = d
+        self.dates: List[datetime.datetime] = sorted(self._owner)
+        self.bands_per_observation = {
+            d: self._owner[d].bands_per_observation[self._source_date[d]]
+            for d in self.dates
+        }
+
+    def define_output(self):
+        """The first source defines the output grid (all sources must have
+        been built against the same state grid)."""
+        return self.sources[0].define_output()
+
+    def get_observations(self, date, gather: PixelGather) -> DateObservation:
+        return self._owner[date].get_observations(
+            self._source_date[date], gather
+        )

@@ -77,6 +77,19 @@ class RoiWindowMixin:
         ulx, uly, lrx, lry = self.roi
         return arr[uly:lry, ulx:lrx]
 
+    def _read_windowed(self, path: str) -> np.ndarray:
+        """Read a raster pre-windowed to the ROI: only the intersecting
+        TIFF tiles are decoded, so a chunked run over a full tile costs
+        chunk-sized I/O per chunk instead of whole-raster decodes (the
+        chunk-restartability I/O property of the reference's per-chunk
+        ``apply_roi``, ``kafka_test_Py36.py:162``)."""
+        from .geotiff import read_geotiff, read_geotiff_window
+
+        if self.roi is None:
+            return read_geotiff(path)[0]
+        ulx, uly, lrx, lry = self.roi
+        return read_geotiff_window(path, uly, ulx, lry - uly, lrx - ulx)[0]
+
     def _shift_geotransform(self, geotransform) -> List[float]:
         """Geotransform of the ROI window (origin moved by ul offsets)."""
         gt = list(geotransform)

@@ -36,7 +36,7 @@ import numpy as np
 from ..core.types import BandBatch
 from ..engine.protocols import DateObservation
 from ..engine.state import PixelGather
-from .geotiff import read_geotiff
+from .geotiff import read_geotiff_window, read_info
 from .warp import grid_mapping, resample
 
 LOG = logging.getLogger(__name__)
@@ -132,6 +132,9 @@ class Sentinel2Observations:
         # of a warp; all 10 bands of a granule share one source grid, so
         # the mapping is computed once and reused.
         self._mapping_cache: Dict[tuple, tuple] = {}
+        # path -> parsed TiffInfo, so repeated windowed reads of one band
+        # file parse its header/IFD once.
+        self._info_cache: Dict[str, Any] = {}
 
     def _find_granules(self) -> None:
         """Index granule directories by acquisition date.
@@ -168,18 +171,35 @@ class Sentinel2Observations:
         return self.state_crs, list(self.state_geotransform)
 
     def _warp_band(self, path: str, dst_shape) -> np.ndarray:
-        arr, info = read_geotiff(path)
+        """Warp one band file onto the state grid, reading only the source
+        window the state grid actually maps into — a chunked run over a
+        10980x10980 tile decodes chunk-sized windows, not whole bands
+        (the streaming-read property of the reference's ``gdal.Warp``)."""
+        info = self._info_cache.get(path)
+        if info is None:
+            info = self._info_cache[path] = read_info(path)
         src_crs = info.geo.epsg if info.geo.epsg else self.state_crs
         key = (tuple(info.geo.geotransform), src_crs, tuple(dst_shape))
         if key not in self._mapping_cache:
-            self._mapping_cache[key] = grid_mapping(
+            col_f, row_f = grid_mapping(
                 info.geo.geotransform, dst_shape, self.state_geotransform,
                 src_crs=src_crs, dst_crs=self.state_crs,
             )
-        col_f, row_f = self._mapping_cache[key]
+            # Source bbox covering every mapped coordinate (+1 for the
+            # bilinear neighbour), clipped to the source raster.
+            c0 = int(max(0, np.floor(col_f.min()) - 1))
+            r0 = int(max(0, np.floor(row_f.min()) - 1))
+            c1 = int(min(info.width, np.ceil(col_f.max()) + 2))
+            r1 = int(min(info.height, np.ceil(row_f.max()) + 2))
+            c1, r1 = max(c1, c0 + 1), max(r1, r0 + 1)
+            self._mapping_cache[key] = (
+                col_f - c0, row_f - r0, r0, c0, r1 - r0, c1 - c0
+            )
+        col_l, row_l, r0, c0, nr, nc = self._mapping_cache[key]
+        win, _ = read_geotiff_window(path, r0, c0, nr, nc, info=info)
         return resample(
-            arr if arr.ndim == 2 else arr[..., 0],
-            col_f, row_f, method="nearest", nodata=0.0,
+            win if win.ndim == 2 else win[..., 0],
+            col_l, row_l, method="nearest", nodata=0.0,
         )
 
     def get_observations(self, date, gather: PixelGather) -> DateObservation:

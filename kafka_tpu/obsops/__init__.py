@@ -28,3 +28,8 @@ from .gp import (
     stack_gp_bank,
 )
 from .mlp import MLPOperator, fit_mlp, mlp_apply
+from .joint import (
+    ProsailJointOperator,
+    WCMJointOperator,
+    joint_state_bounds,
+)

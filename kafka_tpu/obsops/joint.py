@@ -1,0 +1,90 @@
+"""Joint optical + SAR observation operators on one shared state.
+
+The reference ships optical (PROSAIL emulators,
+``/root/reference/kafka/inference/utils.py:181-219``) and SAR (Water-Cloud
+Model, ``observation_operators/sar_forward_model.py``) operators but never
+composes them — its drivers assimilate one sensor each.  These operators
+close that gap: an 11-parameter joint state (the 10 transformed PROSAIL
+parameters + volumetric soil moisture) that Sentinel-2 dates constrain
+through the PROSAIL reflectance operator and Sentinel-1 dates constrain
+through the WCM, so LAI is shared between the sensors and soil moisture
+rides the SAR signal.
+
+State layout (transformed space, matching ``obsops.prosail``):
+
+    [0..9]  PROSAIL state (``PROSAIL_PARAMETER_LIST``), with slot 6 the
+            exponentially transformed LAI: x6 = exp(-LAI/2)
+    [10]    sm: volumetric soil moisture (m^3/m^3)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .prosail import ProsailAux, ProsailOperator
+from .protocol import ObservationModel
+from .wcm import WCMAux, WCM_PARAMETERS, wcm_sigma0
+
+#: Transformed-LAI floor: exp(-10/2), i.e. LAI capped at 10 like the WCM
+#: physical domain.
+_TLAI_MIN = float(np.exp(-5.0))
+
+
+def joint_state_bounds():
+    """(lower, upper) for the 11-parameter joint state: PROSAIL bounds plus
+    the WCM soil-moisture domain (0, 0.6]."""
+    p_lo, p_hi = ProsailOperator.state_bounds
+    lo = np.concatenate([p_lo, [1e-3]]).astype(np.float32)
+    hi = np.concatenate([p_hi, [0.6]]).astype(np.float32)
+    return lo, hi
+
+
+class ProsailJointOperator(ObservationModel):
+    """The PROSAIL S2 operator lifted onto the joint state: reads the first
+    10 parameters, ignores soil moisture (zero Jacobian there, so SM keeps
+    its prior/SAR-constrained value through optical dates)."""
+
+    n_bands = 10
+    n_params = 11
+    state_bounds = joint_state_bounds()
+
+    def __init__(self, hotspot: float = 0.01):
+        self._prosail = ProsailOperator(hotspot=hotspot)
+
+    def forward_pixel(self, aux: Optional[ProsailAux], x_pixel):
+        return self._prosail.forward_pixel(aux, x_pixel[:10])
+
+
+class WCMJointOperator(ObservationModel):
+    """The dual-pol Water-Cloud Model on the joint state: the vegetation
+    descriptor is the PHYSICAL LAI decoded from the transformed slot 6
+    (LAI = -2 ln x6), soil moisture is slot 10.  Autodiff carries the
+    chain rule through the decode, so SAR dates update the same
+    transformed-LAI parameter the optical dates do."""
+
+    n_params = 11
+    state_bounds = joint_state_bounds()
+
+    def __init__(self, polarisations=("VV", "VH")):
+        self.polarisations = tuple(polarisations)
+        for pol in self.polarisations:
+            if pol not in WCM_PARAMETERS:
+                raise ValueError("Only VV and VH polarisations available!")
+        self.n_bands = len(self.polarisations)
+        self._coeffs = np.array(
+            [WCM_PARAMETERS[p] for p in self.polarisations], np.float32
+        )
+
+    def forward_pixel(self, aux: WCMAux, x_pixel):
+        tlai = jnp.clip(x_pixel[6], _TLAI_MIN, 1.0)
+        lai = -2.0 * jnp.log(tlai)
+        sm = x_pixel[10]
+        return jnp.stack(
+            [
+                wcm_sigma0(lai, sm, aux.theta_deg, tuple(c))
+                for c in self._coeffs
+            ]
+        )

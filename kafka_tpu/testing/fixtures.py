@@ -66,12 +66,16 @@ def make_s2_granule_tree(
     noise: float = 0.0,
     seed: int = 0,
     angles=(30.5, 150.0, 5.0, 100.0),
+    dtype=np.float32,
 ):
     """Write a Sentinel-2 granule tree (``YYYY/MM/DD/granule/``) whose
     10-band reflectances are the PROSAIL forward model evaluated at
     ``truth_state`` — physically consistent data for end-to-end driver
     tests, replacing the private ``/data/nemesis`` trees of the reference
-    (``kafka_test_S2.py:151``).  Returns the truth state used."""
+    (``kafka_test_S2.py:151``).  Returns the truth state used.
+
+    ``dtype=np.uint16`` writes DN bands as real S2 L2A products are
+    encoded (half the bytes of float32) — use for at-scale benchmarks."""
     import datetime as _dt
     import os
 
@@ -106,11 +110,16 @@ def make_s2_granule_tree(
         for bi, b in enumerate(BAND_MAP):
             field = np.full((ny, nx), brf[bi], np.float32)
             if noise > 0:
-                field = field + rng.normal(0, noise, field.shape)
+                field = field + rng.normal(
+                    0, noise, field.shape
+                ).astype(np.float32)
             dn = np.clip(field, 1e-4, 1.0) * 10000.0
+            if np.dtype(dtype).kind == "u":
+                dn = np.round(dn)
             write_geotiff(
                 os.path.join(gran, f"B{b}_sur.tif"),
-                dn.astype(np.float32), geo,
+                dn.astype(dtype), geo,
+                predictor=2 if np.dtype(dtype).kind in "ui" else 1,
             )
         write_geotiff(
             os.path.join(gran, "synth_aot.tif"),
@@ -270,3 +279,54 @@ def make_mcd43_series(
             write_geotiff(f"{stem}_{band}_kernels.tif", k, geo)
             write_geotiff(f"{stem}_{band}_qa.tif", qa, geo)
     return truth_state
+
+
+def make_s1_series(
+    dirpath: str,
+    dates,
+    truth_lai: float = 3.0,
+    truth_sm: float = 0.3,
+    ny: int = 64,
+    nx: int = 64,
+    geo: GeoInfo = DEFAULT_GEO,
+    theta_deg: float = 35.0,
+    noise: float = 0.0,
+    seed: int = 0,
+):
+    """Write a folder of preprocessed Sentinel-1 sigma0 NetCDFs whose VV/VH
+    backscatter is the Water-Cloud Model evaluated at (``truth_lai``,
+    ``truth_sm``) — physically consistent SAR data for joint-assimilation
+    tests (file naming/contract of ``io.sentinel1.S1Observations``)."""
+    import os
+
+    import h5py
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..obsops.wcm import WCM_PARAMETERS, wcm_sigma0
+
+    os.makedirs(dirpath, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    s0 = {
+        pol: float(wcm_sigma0(
+            jnp.asarray(truth_lai), jnp.asarray(truth_sm),
+            jnp.asarray(theta_deg), WCM_PARAMETERS[pol],
+        ))
+        for pol in ("VV", "VH")
+    }
+    for date in dates:
+        name = f"S1A_IW_GRDH_1SDV_pre_{date.strftime('%Y%m%dT%H%M%S')}_x_y.nc"
+        with h5py.File(os.path.join(dirpath, name), "w") as f:
+            f.attrs["geotransform"] = np.asarray(geo.geotransform, np.float64)
+            f.attrs["epsg"] = np.int64(geo.epsg or 32630)
+            for pol in ("VV", "VH"):
+                field = np.full((ny, nx), s0[pol], np.float32)
+                if noise > 0:
+                    field = field * (
+                        1.0 + rng.normal(0, noise, field.shape)
+                    ).astype(np.float32)
+                f.create_dataset(f"sigma0_{pol}", data=field)
+            f.create_dataset(
+                "theta", data=np.full((ny, nx), theta_deg, np.float32)
+            )
+    return s0

@@ -223,3 +223,56 @@ class TestMOD09Driver:
         vals = np.asarray(arr)[mask]
         # truth b1 iso = 0.05; the weak prior starts at 0.15
         assert abs(np.median(vals) - truth[0]) < 0.02
+
+
+class TestJointDriver:
+    def test_end_to_end(self, tmp_path):
+        """S2+S1 joint CLI: both sensor trees on disk, one chunked run,
+        soil-moisture outputs pulled from the prior toward the SAR truth."""
+        from kafka_tpu.cli.run_joint import default_config, main
+        from kafka_tpu.testing.fixtures import make_s1_series
+
+        ny, nx = 48, 48
+        data = str(tmp_path / "s2")
+        s1_dir = str(tmp_path / "s1")
+        outdir = str(tmp_path / "out")
+        mask_path = str(tmp_path / "pivots.tif")
+        write_mask(mask_path, ny, nx)
+
+        lai, sm = 3.0, 0.4
+        from kafka_tpu.engine.priors import joint_prior
+        truth10 = np.asarray(joint_prior().prior.mean)[:10].copy()
+        truth10[6] = np.exp(-lai / 2.0)
+        make_s2_granule_tree(
+            data, [day(2017, 7, 4), day(2017, 7, 8)],
+            truth_state=truth10, ny=ny, nx=nx, geo=GEO, noise=0.002,
+        )
+        make_s1_series(
+            s1_dir,
+            [datetime.datetime(2017, 7, 6, 17, 55)],
+            truth_lai=lai, truth_sm=sm, ny=ny, nx=nx, geo=GEO,
+            noise=0.01,
+        )
+
+        cfg = default_config()
+        cfg.chunk_size = (48, 48)
+        cfg.pad_multiple = 64
+        cfg_path = str(tmp_path / "cfg.json")
+        cfg.save(cfg_path)
+        stats = main([
+            "--config", cfg_path, "--data-folder", data,
+            "--s1-folder", s1_dir, "--state-mask", mask_path,
+            "--outdir", outdir,
+        ])
+        assert stats["run"] == 1
+        sm_files = [
+            f for f in glob.glob(os.path.join(outdir, "sm_*.tif"))
+            if not f.endswith("_unc.tif")
+        ]
+        assert sm_files, "joint driver wrote no soil-moisture outputs"
+        last = sorted(sm_files)[-1]
+        arr, _ = read_geotiff(last)
+        vals = np.asarray(arr)[np.asarray(arr) > 0]
+        assert vals.size
+        # moved from the 0.25 prior toward the 0.4 SAR truth
+        assert abs(np.median(vals) - sm) < abs(0.25 - sm)

@@ -1,0 +1,165 @@
+"""Multi-sensor S2+S1 joint assimilation: composite date stream, shared
+11-parameter state, per-sensor operators (obsops.joint, io.multi)."""
+
+import datetime
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from kafka_tpu.engine import KalmanFilter
+from kafka_tpu.engine.priors import JOINT_PARAMETER_LIST, joint_prior
+from kafka_tpu.io.multi import CompositeObservations
+from kafka_tpu.obsops.joint import (
+    ProsailJointOperator,
+    WCMJointOperator,
+    joint_state_bounds,
+)
+from kafka_tpu.obsops.wcm import WCMAux, WCM_PARAMETERS, wcm_sigma0
+from kafka_tpu.testing import MemoryOutput, SyntheticObservations
+
+
+def day(i, hour=0):
+    return datetime.datetime(2017, 7, 1 + i, hour)
+
+
+class TestJointOperators:
+    def test_prosail_joint_matches_base_and_ignores_sm(self):
+        from kafka_tpu.obsops.prosail import ProsailAux, ProsailOperator
+
+        op = ProsailJointOperator()
+        base = ProsailOperator()
+        aux = ProsailAux(
+            sza=jnp.asarray(30.0), vza=jnp.asarray(5.0),
+            raa=jnp.asarray(50.0),
+        )
+        x10 = np.asarray(joint_prior().prior.mean)[:10]
+        for sm in (0.05, 0.3, 0.55):
+            x11 = jnp.asarray(np.concatenate([x10, [sm]]), jnp.float32)
+            brf = op.forward_pixel(aux, x11)
+            np.testing.assert_allclose(
+                np.asarray(brf),
+                np.asarray(base.forward_pixel(aux, jnp.asarray(x10))),
+                atol=1e-6,
+            )
+        # zero Jacobian w.r.t. soil moisture
+        lin = op.linearize(aux, jnp.asarray(
+            np.concatenate([x10, [0.3]]), jnp.float32)[None, :])
+        assert np.abs(np.asarray(lin.jac)[:, 0, 10]).max() == 0.0
+
+    def test_wcm_joint_decodes_physical_lai(self):
+        op = WCMJointOperator()
+        lai, sm, theta = 3.0, 0.3, 35.0
+        x = np.zeros(11, np.float32)
+        x[6] = np.exp(-lai / 2.0)
+        x[10] = sm
+        out = op.forward_pixel(
+            WCMAux(theta_deg=jnp.asarray(theta)), jnp.asarray(x)
+        )
+        for bi, pol in enumerate(("VV", "VH")):
+            expect = float(wcm_sigma0(
+                jnp.asarray(lai), jnp.asarray(sm), jnp.asarray(theta),
+                WCM_PARAMETERS[pol],
+            ))
+            np.testing.assert_allclose(float(out[bi]), expect, rtol=1e-5)
+
+    def test_wcm_joint_jacobian_couples_lai_and_sm_only(self):
+        op = WCMJointOperator()
+        x = np.full(11, 0.5, np.float32)
+        x[6] = np.exp(-1.5)
+        x[10] = 0.25
+        lin = op.linearize(
+            WCMAux(theta_deg=jnp.asarray(np.full(1, 35.0, np.float32))),
+            jnp.asarray(x)[None, :],
+        )
+        jac = np.asarray(lin.jac)[:, 0]  # (2, 11)
+        touched = np.abs(jac).max(axis=0) > 0
+        assert touched[6] and touched[10]
+        assert not touched[[0, 1, 2, 3, 4, 5, 7, 8, 9]].any()
+
+
+class TestCompositeObservations:
+    def _sources(self):
+        op = ProsailJointOperator()
+        truth = np.zeros((4, 4, 11), np.float32)
+        a = SyntheticObservations(
+            dates=[day(1), day(3)], operator=op,
+            truth_fn=lambda d: truth, sigma=0.05, seed=0,
+        )
+        b = SyntheticObservations(
+            dates=[day(2), day(3)], operator=op,
+            truth_fn=lambda d: truth, sigma=0.05, seed=1,
+        )
+        return a, b
+
+    def test_union_dates_and_dispatch(self):
+        a, b = self._sources()
+        comp = CompositeObservations([a, b])
+        assert len(comp.dates) == 4  # day3 duplicated -> nudged, kept
+        assert comp.dates[0] == day(1)
+        # the nudged duplicate is 1 s after the original
+        dupes = [d for d in comp.dates if d.day == 4]
+        assert len(dupes) == 2
+        assert (dupes[1] - dupes[0]).total_seconds() == pytest.approx(2.0)
+
+    def test_bands_per_observation_follows_owner(self):
+        a, b = self._sources()
+        comp = CompositeObservations([a, b])
+        assert all(v == a.bands_per_observation[a.dates[0]]
+                   for v in comp.bands_per_observation.values())
+
+
+class TestJointEndToEnd:
+    def test_s1_dates_constrain_soil_moisture(self):
+        """A joint run where S2 dates see reflectance and S1 dates see
+        backscatter: soil moisture must move from the prior (0.25) toward
+        the SAR truth (0.4), and its posterior information must exceed
+        the optical-only run's (which cannot observe SM at all)."""
+        ny = nx = 8
+        mask = np.ones((ny, nx), bool)
+        prior = joint_prior()
+        truth = np.zeros((ny, nx, 11), np.float32)
+        truth[:] = np.asarray(prior.prior.mean)
+        truth[..., 6] = np.exp(-3.0 / 2.0)   # LAI 3
+        truth[..., 10] = 0.4                 # SAR-visible soil moisture
+
+        s2_op = ProsailJointOperator()
+        wcm_op = WCMJointOperator()
+        theta = jnp.asarray(np.full(64, 35.0, np.float32))
+
+        def build(with_s1):
+            s2 = SyntheticObservations(
+                dates=[day(1), day(5)], operator=s2_op,
+                truth_fn=lambda d: truth, sigma=0.005, seed=3,
+            )
+            sources = [s2]
+            if with_s1:
+                s1 = SyntheticObservations(
+                    dates=[day(2), day(4)], operator=wcm_op,
+                    truth_fn=lambda d: truth, sigma=0.003, seed=4,
+                    aux_fn=lambda d, g: WCMAux(theta_deg=theta),
+                )
+                sources.append(s1)
+            obs = CompositeObservations(sources)
+            kf = KalmanFilter(
+                obs, MemoryOutput(), mask, JOINT_PARAMETER_LIST,
+                state_propagation=None, prior=None, pad_multiple=64,
+                solver_options={"relaxation": 0.7},
+            )
+            x0, p_inv0 = prior.process_prior(None, kf.gather)
+            x_a, _, p_inv_a = kf.run([day(0), day(6)], x0, None, p_inv0)
+            return np.asarray(x_a), np.asarray(p_inv_a)
+
+        x_joint, p_inv_joint = build(with_s1=True)
+        x_opt, p_inv_opt = build(with_s1=False)
+
+        sm_joint = x_joint[:64, 10]
+        sm_opt = x_opt[:64, 10]
+        # Optical-only leaves SM at its prior; SAR pulls it to ~0.4.
+        np.testing.assert_allclose(sm_opt, 0.25, atol=1e-3)
+        assert np.abs(sm_joint - 0.4).mean() < 0.05
+        # SAR adds information on the SM diagonal.
+        assert (p_inv_joint[:64, 10, 10] > 2 * p_inv_opt[:64, 10, 10]).all()
+        # And LAI stays optically constrained in both.
+        lai_joint = -2 * np.log(np.clip(x_joint[:64, 6], 1e-6, 1))
+        assert np.abs(lai_joint - 3.0).mean() < 0.35

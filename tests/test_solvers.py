@@ -308,3 +308,63 @@ class TestHessianCorrection:
             jnp.asarray(p_inv), params, hessian_forward=self._quad_forward,
         )
         np.testing.assert_allclose(np.asarray(a_corr), np.asarray(a_plain))
+
+
+class TestBlockedLinearize:
+    """linearize_block must be numerically identical to the unblocked path
+    (it exists purely to bound peak device memory)."""
+
+    def test_blocked_equals_unblocked(self):
+        import jax.numpy as jnp
+
+        from kafka_tpu.core.solvers import iterated_solve
+        from kafka_tpu.testing.synthetic import make_tip_problem
+
+        op, bands, x0, p_inv0 = make_tip_problem(700)  # not block-aligned
+        args = dict(
+            obs=bands, x_forecast=x0, p_inv_forecast=p_inv0,
+            operator_params=None,
+            state_bounds=(
+                jnp.asarray(op.state_bounds[0]),
+                jnp.asarray(op.state_bounds[1]),
+            ),
+        )
+        x_ref, a_ref, d_ref = iterated_solve(op.linearize, **args)
+        x_blk, a_blk, d_blk = iterated_solve(
+            op.linearize, linearize_block=256, **args
+        )
+        # Blocked evaluation reorders float32 fusions, and the GN loop
+        # feeds those last-ulp differences back on itself — agreement is
+        # to solver tolerance, not bitwise.
+        np.testing.assert_allclose(
+            np.asarray(x_blk), np.asarray(x_ref), atol=5e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(a_blk), np.asarray(a_ref), rtol=2e-2, atol=1e-2
+        )
+        assert int(d_blk.n_iterations) == int(d_ref.n_iterations)
+
+    def test_blocked_with_per_pixel_aux(self):
+        import jax.numpy as jnp
+
+        from kafka_tpu.core.solvers import _blocked_linearize, _call_linearize
+        from kafka_tpu.obsops.wcm import WCMAux, WCMOperator
+
+        n = 130  # forces edge-padding with block=64
+        rng = np.random.default_rng(0)
+        op = WCMOperator()
+        x = jnp.asarray(
+            np.stack([rng.uniform(0.5, 5, n), rng.uniform(0.05, 0.5, n)],
+                     axis=1), jnp.float32
+        )
+        aux = WCMAux(theta_deg=jnp.asarray(
+            rng.uniform(20, 45, n).astype(np.float32)
+        ))
+        ref = _call_linearize(op.linearize, aux, x)
+        blk = _blocked_linearize(op.linearize, aux, x, 64)
+        np.testing.assert_allclose(
+            np.asarray(blk.h0), np.asarray(ref.h0), atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(blk.jac), np.asarray(ref.jac), atol=1e-6
+        )

@@ -1,0 +1,240 @@
+"""Measure the BASELINE.md table rows — end-to-end, host I/O included.
+
+Each mode generates a synthetic on-disk data tree at the row's problem
+scale (PROSAIL-consistent S2 granules, ``testing.fixtures``), runs the
+REAL driver path (chunked ``cli.drivers.run_config`` or the engine
+directly) on the default JAX device, and prints one JSON line.  Data
+generation is excluded from the timed window; reading, warping,
+gathering, solving and GeoTIFF writing are all inside it.
+
+Modes
+-----
+- ``barrax``  — the reference's S2-Barrax problem scale (pivot mask,
+  204x235 grid, 2-day grid; ``kafka_test_S2.py:189-205``).
+- ``tile``    — one full Sentinel-2 L2A tile (10980x10980 default),
+  single date, chunked.
+- ``annual``  — an annual series (~50 acquisitions) on one sub-tile,
+  chunked, temporal KF chain.
+- ``oracle``  — the reference algorithm (SciPy sparse + SuperLU) on this
+  host's CPU for px/s context (same solve, no I/O — generous to it).
+
+Usage: ``python tools/measure_baseline.py tile --size 10980 --chunk 2196``
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _granule_tree(root, dates, size, noise=0.002, dtype=np.uint16):
+    from kafka_tpu.testing.fixtures import DEFAULT_GEO, make_s2_granule_tree
+
+    if os.path.isdir(f"{root}/s2"):
+        print(f"reusing existing granule tree {root}/s2", file=sys.stderr)
+        return f"{root}/s2", DEFAULT_GEO
+    t0 = time.perf_counter()
+    make_s2_granule_tree(
+        f"{root}/s2", dates, ny=size, nx=size, noise=noise, dtype=dtype
+    )
+    print(
+        f"generated {len(dates)} x {size}x{size} granules "
+        f"in {time.perf_counter() - t0:.1f}s",
+        file=sys.stderr,
+    )
+    return f"{root}/s2", DEFAULT_GEO
+
+
+def _mask_tif(root, size, geo):
+    from kafka_tpu.io import write_geotiff
+
+    path = f"{root}/mask.tif"
+    write_geotiff(path, np.ones((size, size), np.uint8), geo)
+    return path
+
+
+def _s2_config(data_folder, mask_path, outdir, dates, chunk):
+    from kafka_tpu.cli.run_s2 import default_config
+
+    cfg = default_config()
+    cfg.data_folder = data_folder
+    cfg.state_mask = mask_path
+    cfg.output_folder = outdir
+    cfg.chunk_size = (chunk, chunk)
+    # Grid boundaries BRACKET the acquisitions (windows are half-open
+    # intervals ending at each grid point, so a grid starting ON the first
+    # acquisition date would never assimilate it).
+    cfg.start = dates[0] - datetime.timedelta(days=1)
+    cfg.end = dates[-1] + datetime.timedelta(days=1)
+    return cfg
+
+
+def _run_chunked(size, chunk, n_dates, step_days=2, keep=None):
+    from kafka_tpu.cli.drivers import prosail_aux_builder, run_config
+
+    root = keep or tempfile.mkdtemp(prefix="kafka_baseline_")
+    try:
+        dates = [
+            datetime.datetime(2017, 7, 1) + datetime.timedelta(
+                days=step_days * i
+            )
+            for i in range(n_dates)
+        ]
+        data, geo = _granule_tree(root, dates, size)
+        mask = _mask_tif(root, size, geo)
+        cfg = _s2_config(data, mask, f"{root}/out", dates, chunk)
+        cfg.step_days = step_days
+        t0 = time.perf_counter()
+        stats = run_config(cfg, aux_builder=prosail_aux_builder)
+        wall = time.perf_counter() - t0
+        n_px = stats["pixels"]
+        # GUARD: every chunk must actually have assimilated every date —
+        # a mis-built time grid silently yields a no-op run and a garbage
+        # throughput figure.
+        expected = stats["chunks_with_pixels"] * n_dates
+        if stats.get("dates_assimilated", -1) != expected:
+            raise RuntimeError(
+                f"assimilated {stats.get('dates_assimilated')} chunk-dates, "
+                f"expected {expected} — time grid/window mismatch"
+            )
+        px_steps_s = n_px * n_dates / wall
+        return {
+            "n_pixels": n_px,
+            "n_dates": n_dates,
+            "chunks": stats["run"],
+            "wall_s": round(wall, 2),
+            "pixel_steps_per_s": round(px_steps_s, 1),
+        }
+    finally:
+        if keep is None:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+def _run_joint(size, chunk, n_s2, n_s1, keep=None):
+    """Multi-sensor row: S2 optical + S1 SAR interleaved on the shared
+    11-parameter joint state (``cli.run_joint``)."""
+    from kafka_tpu.cli.drivers import prosail_aux_builder, run_config
+    from kafka_tpu.cli.run_joint import default_config
+    from kafka_tpu.engine.priors import joint_prior
+    from kafka_tpu.testing.fixtures import make_s1_series
+
+    root = keep or tempfile.mkdtemp(prefix="kafka_joint_")
+    try:
+        s2_dates = [
+            datetime.datetime(2017, 7, 1) + datetime.timedelta(days=4 * i)
+            for i in range(n_s2)
+        ]
+        s1_dates = [
+            datetime.datetime(2017, 7, 3, 17) +
+            datetime.timedelta(days=4 * i)
+            for i in range(n_s1)
+        ]
+        truth10 = np.asarray(joint_prior().prior.mean)[:10].copy()
+        truth10 = truth10.astype(np.float32)
+        truth10[6] = np.float32(np.exp(-1.5))
+        data, geo = _granule_tree(root, s2_dates, size)
+        if not os.path.isdir(f"{root}/s1"):
+            make_s1_series(
+                f"{root}/s1", s1_dates, truth_lai=3.0, truth_sm=0.4,
+                ny=size, nx=size, geo=geo, noise=0.01,
+            )
+        mask = _mask_tif(root, size, geo)
+        cfg = default_config()
+        cfg.data_folder = data
+        cfg.extra["s1_folder"] = f"{root}/s1"
+        cfg.state_mask = mask
+        cfg.output_folder = f"{root}/out"
+        cfg.chunk_size = (chunk, chunk)
+        all_dates = sorted(s2_dates + s1_dates)
+        cfg.start = all_dates[0] - datetime.timedelta(days=1)
+        cfg.end = all_dates[-1] + datetime.timedelta(days=1)
+        cfg.step_days = 2
+        n_dates = len(all_dates)
+        t0 = time.perf_counter()
+        stats = run_config(cfg, aux_builder=prosail_aux_builder)
+        wall = time.perf_counter() - t0
+        expected = stats["chunks_with_pixels"] * n_dates
+        if stats.get("dates_assimilated", -1) != expected:
+            raise RuntimeError(
+                f"assimilated {stats.get('dates_assimilated')} chunk-dates,"
+                f" expected {expected}"
+            )
+        return {
+            "n_pixels": stats["pixels"],
+            "n_dates": n_dates,
+            "n_s2": len(s2_dates), "n_s1": len(s1_dates),
+            "wall_s": round(wall, 2),
+            "pixel_steps_per_s": round(
+                stats["pixels"] * n_dates / wall, 1
+            ),
+        }
+    finally:
+        if keep is None:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("mode",
+                    choices=["barrax", "tile", "annual", "joint", "oracle"])
+    ap.add_argument("--size", type=int, default=None)
+    ap.add_argument("--chunk", type=int, default=2196)
+    ap.add_argument("--dates", type=int, default=None)
+    ap.add_argument("--step-days", type=int, default=2)
+    ap.add_argument("--oracle-n", type=int, default=16384)
+    ap.add_argument("--keep", default=None,
+                    help="keep generated tree/outputs in this directory")
+    args = ap.parse_args()
+
+    if args.mode == "barrax":
+        sys.path.insert(
+            0,
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        from bench import bench_end_to_end
+
+        px_steps_s, device_frac, n_pix = bench_end_to_end()
+        row = {
+            "row": "barrax", "n_pixels": n_pix,
+            "pixel_steps_per_s": round(px_steps_s, 1),
+            "device_fraction": round(device_frac, 3),
+        }
+    elif args.mode == "tile":
+        row = {"row": "tile", **_run_chunked(
+            args.size or 10980, args.chunk, args.dates or 1,
+            keep=args.keep,
+        )}
+    elif args.mode == "annual":
+        row = {"row": "annual", **_run_chunked(
+            args.size or 1098, min(args.chunk, args.size or 1098),
+            args.dates or 50, step_days=args.step_days, keep=args.keep,
+        )}
+    elif args.mode == "joint":
+        size = args.size or 1098
+        row = {"row": "joint", **_run_joint(
+            size, min(args.chunk, size),
+            n_s2=(args.dates or 12) // 2, n_s1=(args.dates or 12) // 2,
+            keep=args.keep,
+        )}
+    else:
+        from bench import bench_oracle
+
+        row = {
+            "row": "oracle", "n_pixels": args.oracle_n,
+            "px_per_s": round(bench_oracle(args.oracle_n), 1),
+        }
+    print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()
