@@ -117,8 +117,27 @@ def run_one_chunk(
             "RunConfig needs `prior` or `initial_prior` for the start state"
         )
     x0, p_inv0 = init_prior.process_prior(None, kf.gather)
+    grid = cfg.time_grid()
+    checkpointer = None
+    advance_first = False
+    if cfg.checkpoint_folder:
+        from ..engine.checkpoint import Checkpointer
+
+        checkpointer = Checkpointer(
+            cfg.checkpoint_folder, prefix=f"{prefix}_",
+            n_shards=int(cfg.extra.get("checkpoint_shards", 1)),
+        )
+        grid, seed = checkpointer.resume_time_grid(grid)
+        if seed is not None:
+            x0, p_inv0 = seed
+            advance_first = True
+            LOG.info(
+                "chunk %s: resuming from checkpoint at %s (%d steps left)",
+                prefix, grid[0], len(grid) - 1,
+            )
     t0 = time.time()
-    kf.run(cfg.time_grid(), x0, None, p_inv0)
+    kf.run(grid, x0, None, p_inv0, checkpointer=checkpointer,
+           advance_first=advance_first)
     output.close()
     return {
         "prefix": prefix,

@@ -1,0 +1,75 @@
+"""Sentinel-1 SAR-only assimilation driver (Water-Cloud Model).
+
+The reference ships the analytic WCM operator and an S1 sigma0 reader but
+never wires them into a driver (``/root/reference/kafka/
+observation_operators/sar_forward_model.py``,
+``input_output/Sentinel1_Observations.py`` — both unused by the three
+shipped scripts).  This driver completes that path: a 2-parameter
+(LAI, soil moisture) state retrieved from dual-pol VV/VH backscatter time
+series with the per-pixel incidence angle the reference left as a TODO,
+information-filter propagation between acquisitions.
+
+Usage:
+    python -m kafka_tpu.cli.run_s1 --data-folder /path/s1_ncs \
+        --state-mask mask.tif --outdir /tmp/kafka_s1
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import logging
+
+from ..engine.config import RunConfig
+from ..engine.priors import WCM_PARAMETER_LIST
+from .drivers import run_config
+
+
+def default_config() -> RunConfig:
+    """SAR-only defaults: 2-param WCM state, broad prior seeding the
+    initial state, information filter carrying it between acquisitions
+    (soil moisture decorrelates fast — larger Q)."""
+    return RunConfig(
+        parameter_list=WCM_PARAMETER_LIST,
+        start=datetime.datetime(2017, 7, 1),
+        end=datetime.datetime(2017, 7, 31),
+        step_days=3,
+        operator="wcm",
+        propagator="information_filter",
+        prior=None,
+        initial_prior="wcm",
+        q_diag=[5e-3, 2e-2],
+        chunk_size=(256, 256),
+        observations="sentinel1",
+    )
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", default=None,
+                    help="RunConfig JSON overriding the defaults")
+    ap.add_argument("--data-folder", default=None, help="S1 NetCDF folder")
+    ap.add_argument("--state-mask", default=None)
+    ap.add_argument("--outdir", default=None)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING
+    )
+
+    cfg = RunConfig.load(args.config) if args.config else default_config()
+    if args.data_folder:
+        cfg.data_folder = args.data_folder
+    if args.state_mask:
+        cfg.state_mask = args.state_mask
+    if args.outdir:
+        cfg.output_folder = args.outdir
+
+    stats = run_config(cfg)
+    print(json.dumps(stats))
+    return stats
+
+
+if __name__ == "__main__":
+    main()

@@ -80,7 +80,9 @@ def _make_prosail(cfg):
 
 
 def _named_prior(name: Optional[str], cfg: Optional["RunConfig"] = None):
-    from .priors import jrc_prior, joint_prior, kernels_prior, sail_prior
+    from .priors import (
+        jrc_prior, joint_prior, kernels_prior, sail_prior, wcm_prior,
+    )
 
     if name is None:
         return None
@@ -101,6 +103,7 @@ def _named_prior(name: Optional[str], cfg: Optional["RunConfig"] = None):
         "jrc": jrc_prior,
         "sail": sail_prior,
         "joint": joint_prior,
+        "wcm": wcm_prior,
     }[name]()
 
 
@@ -137,6 +140,13 @@ class RunConfig:
     #: double-buffered observation prefetch depth; 0 = synchronous reads
     prefetch_depth: int = 2
     solver_options: Optional[dict] = None
+    #: folder for per-timestep state checkpoints (packed-triangle .npz,
+    #: prefixed per chunk).  A restarted run resumes each unfinished chunk
+    #: from its latest complete checkpoint instead of its first date —
+    #: mid-chunk crash recovery on top of the scheduler's whole-chunk
+    #: ``.done`` markers.  ``extra["checkpoint_shards"]`` splits each
+    #: checkpoint's pixel axis across that many files.
+    checkpoint_folder: Optional[str] = None
     extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
@@ -207,6 +217,15 @@ class RunConfig:
             return SynergyKernels(
                 self.data_folder, operator,
                 start_time=self.start, end_time=self.end,
+            )
+        if self.observations == "sentinel1":
+            from ..io.sentinel1 import S1Observations
+
+            return S1Observations(
+                self.data_folder, state_geo, operator=operator,
+                relative_uncertainty=self.extra.get(
+                    "relative_uncertainty", 0.05
+                ),
             )
         if self.observations == "joint":
             # Multi-sensor S2 optical + S1 SAR on the shared 11-parameter

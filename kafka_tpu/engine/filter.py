@@ -265,27 +265,40 @@ class KalmanFilter:
                 p_analysis, p_analysis_inverse
             )
             with annotate("kafka/dump"):
+                # x/diag stay device arrays: an async writer then pays the
+                # device->host transfer on its own thread, off the loop.
                 self.output.dump_data(
-                    timestep, np.asarray(x_analysis), p_inv_diag,
+                    timestep, x_analysis, p_inv_diag,
                     self.gather, self.parameter_list,
                 )
             if checkpointer is not None:
-                checkpointer.save(
-                    timestep, x_analysis, p_analysis_inverse
-                )
+                # A checkpoint asserts "everything up to this timestep is
+                # durable": drain any queued async GeoTIFF writes first,
+                # else a crash between save and the writer thread loses
+                # outputs that resume will never re-create.
+                flush = getattr(self.output, "flush", None)
+                if flush is not None:
+                    flush()
+                # Persist in information form regardless of propagator:
+                # covariance-form steps (standard Kalman) hand back P,
+                # which would otherwise be dropped on resume.
+                p_inv_ck = p_analysis_inverse
+                if p_inv_ck is None and p_analysis is not None:
+                    p_inv_ck = spd_inverse_batched(
+                        jnp.asarray(p_analysis, jnp.float32)
+                    )
+                checkpointer.save(timestep, x_analysis, p_inv_ck)
         return x_analysis, p_analysis, p_analysis_inverse
 
     @staticmethod
     def _information_diagonal(p_analysis, p_analysis_inverse):
         """Per-pixel information diagonal for the sigma outputs
-        (``observations.py:393``: sigma = 1/sqrt(diag(P_inv)))."""
+        (``observations.py:393``: sigma = 1/sqrt(diag(P_inv))).  Stays a
+        device array — consumers materialise it when they need it."""
         if p_analysis_inverse is not None:
-            return np.asarray(
-                jnp.diagonal(p_analysis_inverse, axis1=-2, axis2=-1)
-            )
+            return jnp.diagonal(p_analysis_inverse, axis1=-2, axis2=-1)
         if p_analysis is not None:
-            return 1.0 / np.maximum(
-                np.asarray(jnp.diagonal(p_analysis, axis1=-2, axis2=-1)),
-                1e-30,
+            return 1.0 / jnp.maximum(
+                jnp.diagonal(p_analysis, axis1=-2, axis2=-1), 1e-30
             )
         return None

@@ -130,3 +130,23 @@ def joint_prior() -> FixedGaussianPrior:
         inv_cov=jnp.asarray(inv_cov),
     )
     return FixedGaussianPrior(prior, JOINT_PARAMETER_LIST)
+
+
+# The 2-parameter WCM state of the SAR-only path (obsops.wcm).
+WCM_PARAMETER_LIST = ("lai", "sm")
+
+
+def wcm_prior() -> FixedGaussianPrior:
+    """Prior for the SAR-only Water-Cloud state: broad LAI (mean 2, sigma
+    2 over the (0, 10] domain) and soil moisture (mean 0.25, sigma 0.15
+    over (0, 0.6]) — both essentially uninformative, so the retrieval is
+    SAR-driven (the reference ships the WCM operator but no prior or
+    driver for it, ``sar_forward_model.py``)."""
+    mean = np.array([2.0, 0.25], np.float32)
+    sigma = np.array([2.0, 0.15], np.float32)
+    prior = PixelPrior(
+        mean=jnp.asarray(mean),
+        cov=jnp.asarray(np.diag(sigma**2), jnp.float32),
+        inv_cov=jnp.asarray(np.diag(1.0 / sigma**2), jnp.float32),
+    )
+    return FixedGaussianPrior(prior, WCM_PARAMETER_LIST)

@@ -276,3 +276,92 @@ class TestJointDriver:
         assert vals.size
         # moved from the 0.25 prior toward the 0.4 SAR truth
         assert abs(np.median(vals) - sm) < abs(0.25 - sm)
+
+
+class TestS1Driver:
+    def test_end_to_end(self, tmp_path):
+        """SAR-only CLI: WCM state (LAI, SM) retrieved from VV/VH
+        backscatter series with a broad prior."""
+        from kafka_tpu.cli.run_s1 import default_config, main
+        from kafka_tpu.testing.fixtures import make_s1_series
+
+        ny, nx = 40, 40
+        s1_dir = str(tmp_path / "s1")
+        outdir = str(tmp_path / "out")
+        mask_path = str(tmp_path / "mask.tif")
+        write_mask(mask_path, ny, nx)
+        lai, sm = 3.0, 0.4
+        make_s1_series(
+            s1_dir,
+            [datetime.datetime(2017, 7, 2 + 6 * i, 17) for i in range(3)],
+            truth_lai=lai, truth_sm=sm, ny=ny, nx=nx, geo=GEO, noise=0.01,
+        )
+
+        cfg = default_config()
+        cfg.chunk_size = (40, 40)
+        cfg.pad_multiple = 64
+        cfg_path = str(tmp_path / "cfg.json")
+        cfg.save(cfg_path)
+        stats = main([
+            "--config", cfg_path, "--data-folder", s1_dir,
+            "--state-mask", mask_path, "--outdir", outdir,
+        ])
+        assert stats["run"] == 1
+        for param, truth, prior0 in (("sm", sm, 0.25), ("lai", lai, 2.0)):
+            files = [
+                f for f in glob.glob(os.path.join(outdir, f"{param}_*.tif"))
+                if not f.endswith("_unc.tif")
+            ]
+            assert files, f"no {param} outputs"
+            arr, _ = read_geotiff(sorted(files)[-1])
+            vals = np.asarray(arr)[np.asarray(arr) > 0]
+            assert vals.size
+            assert abs(np.median(vals) - truth) < abs(prior0 - truth), param
+
+
+class TestCheckpointedDriver:
+    def test_mid_chunk_resume(self, tmp_path):
+        """checkpoint_folder: an interrupted chunk resumes from its latest
+        complete checkpoint instead of re-assimilating every date."""
+        from kafka_tpu.cli.drivers import prosail_aux_builder, run_config
+        from kafka_tpu.cli.run_s2 import default_config
+
+        ny, nx = 32, 32
+        data = str(tmp_path / "s2")
+        mask_path = str(tmp_path / "pivots.tif")
+        write_mask(mask_path, ny, nx)
+        dates = [day(2017, 7, 4), day(2017, 7, 6), day(2017, 7, 8)]
+        make_s2_granule_tree(data, dates, ny=ny, nx=nx, geo=GEO,
+                             noise=0.002)
+
+        def build(end):
+            cfg = default_config()
+            cfg.chunk_size = (32, 32)
+            cfg.pad_multiple = 64
+            cfg.data_folder = data
+            cfg.state_mask = mask_path
+            cfg.output_folder = str(tmp_path / "out")
+            cfg.checkpoint_folder = str(tmp_path / "ck")
+            cfg.end = end
+            return cfg
+
+        # "Crash" after the first two grid windows: run a truncated grid.
+        stats1 = run_config(build(datetime.datetime(2017, 7, 7)),
+                            aux_builder=prosail_aux_builder)
+        assert stats1["dates_assimilated"] == 2
+        cks = os.listdir(str(tmp_path / "ck"))
+        assert cks and all(c.startswith("0001_state_") for c in cks)
+
+        # Restart with the full grid: the chunk's .done marker is from the
+        # truncated run — clear it, as a restarted job with a longer grid
+        # would.  Resume must only assimilate the remaining date.
+        for m in glob.glob(
+            os.path.join(str(tmp_path / "out"), ".chunk_*.done")
+        ):
+            os.remove(m)
+        stats2 = run_config(build(datetime.datetime(2017, 7, 9)),
+                            aux_builder=prosail_aux_builder)
+        assert stats2["dates_assimilated"] == 1
+        tifs = glob.glob(os.path.join(str(tmp_path / "out"),
+                                      "lai_A2017190_*.tif"))
+        assert tifs, "resumed run wrote no outputs for the final window"
