@@ -22,13 +22,19 @@ import jax.numpy as jnp
 UNROLL_MAX_P = 16
 
 
-def _solve_chol_unrolled(l, b: jnp.ndarray) -> jnp.ndarray:
-    """Forward+back substitution against an unrolled factor; ``b`` (..., p)."""
+def solve_chol_vectors(l, b_vectors):
+    """Forward+back substitution against an unrolled packed factor.
+
+    ``b_vectors`` is a list of p batch vectors (any common shape); returns
+    the solution as a list of p batch vectors.  Layout-agnostic on
+    purpose: the XLA path feeds ``(n,)`` batch vectors and the Pallas
+    kernel feeds ``(block,)`` lane vectors — one implementation of the
+    substitution for both."""
     p = len(l)
     # L y = b
     y = [None] * p
     for i in range(p):
-        s = b[..., i]
+        s = b_vectors[i]
         for k in range(i):
             s = s - l[i][k] * y[k]
         y[i] = s / l[i][i]
@@ -39,6 +45,13 @@ def _solve_chol_unrolled(l, b: jnp.ndarray) -> jnp.ndarray:
         for k in range(i + 1, p):
             s = s - l[k][i] * x[k]
         x[i] = s / l[i][i]
+    return x
+
+
+def _solve_chol_unrolled(l, b: jnp.ndarray) -> jnp.ndarray:
+    """Forward+back substitution against an unrolled factor; ``b`` (..., p)."""
+    p = len(l)
+    x = solve_chol_vectors(l, [b[..., i] for i in range(p)])
     return jnp.stack(x, axis=-1)
 
 

@@ -1,0 +1,108 @@
+"""Pallas TPU kernel for the hot solve: fused packed Cholesky + substitution.
+
+The per-date update factorises ``n_pix`` independent p x p SPD systems.
+The default path (``linalg.solve_spd_packed``) expresses this as a few
+hundred fused elementwise VPU ops that XLA schedules; this module provides
+the same computation as ONE hand-written Pallas kernel: pixels ride the
+lane axis, the ``p(p+1)/2`` packed coefficients ride sublanes, and the
+whole factor+solve for a block of pixels happens VMEM-resident in a single
+kernel launch — no intermediate HBM round-trips between the ~300 fused ops.
+
+Opt-in via ``solver_options={"use_pallas": True}`` (structural, jit-static)
+— the XLA path remains the default; a parity test pins both to the same
+results.  Layout contract: coefficient ``(i, j)`` with ``j <= i`` of the
+lower triangle lives at row ``i (i + 1) / 2 + j``, matching
+``linalg.cholesky_packed``'s list-of-lists ordering.
+
+Measured on a real v5e chip (TIP problem, 2^19 pixels, full GN loop):
+21.3 ms/solve vs 19.4 ms for the XLA-fused path — XLA's automatic fusion
+is already near-optimal for this pure-VPU workload, which is why the
+kernel is opt-in rather than default.  It exists as the Mosaic foothold
+for work XLA cannot schedule (fusing the normal-equations assembly's
+band reduction into the factorisation, block-resident multi-iteration
+solves).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .linalg import cholesky_packed, solve_chol_vectors
+
+
+def tri_rows(p: int) -> int:
+    return p * (p + 1) // 2
+
+
+def _solve_kernel(p: int, a_ref, b_ref, x_ref):
+    """One pixel block: Cholesky factor + forward/back substitution.
+
+    Reuses the SAME unrolled helpers as the XLA path
+    (``linalg.cholesky_packed`` / ``solve_chol_vectors`` — batch-axis
+    agnostic jnp arithmetic, which lowers inside a Pallas kernel), so
+    there is exactly one implementation of the numerically delicate
+    factorisation to maintain.  Everything stays in (block,)-lane row
+    vectors: no in-kernel transpose (a (block, p) relayout pads p up to
+    the 128-lane tile and overflows VMEM)."""
+
+    def idx(i, j):
+        return i * (i + 1) // 2 + j
+
+    a_pk = [[None] * p for _ in range(p)]
+    for i in range(p):
+        for j in range(i + 1):
+            a_pk[i][j] = a_pk[j][i] = a_ref[idx(i, j), :]
+    l = cholesky_packed(a_pk)
+    x = solve_chol_vectors(l, [b_ref[i, :] for i in range(p)])
+    for i in range(p):
+        x_ref[i, :] = x[i]
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def solve_rows(a_rows: jnp.ndarray, b_rows: jnp.ndarray,
+               block: int = 1024, interpret: bool = False) -> jnp.ndarray:
+    """Solve the packed batch in row layout.
+
+    ``a_rows``: (p(p+1)/2, n) lower-triangle coefficients, ``b_rows``:
+    (p, n); returns x (p, n).  ``block`` is a maximum: the actual block
+    is its gcd with ``n`` so every pixel count divides cleanly (engine
+    batches are multiples of 128/256, giving full-width blocks).
+    """
+    n_coeff, n = a_rows.shape
+    p = b_rows.shape[0]
+    if tri_rows(p) != n_coeff:
+        raise ValueError(f"{n_coeff} coefficient rows for p={p}")
+    block = math.gcd(n, min(block, n))
+    return pl.pallas_call(
+        functools.partial(_solve_kernel, p),
+        out_shape=jax.ShapeDtypeStruct((p, n), jnp.float32),
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec((n_coeff, block), lambda i: (0, i)),
+            pl.BlockSpec((p, block), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((p, block), lambda i: (0, i)),
+        interpret=interpret,
+    )(a_rows.astype(jnp.float32), b_rows.astype(jnp.float32))
+
+
+def solve_spd_packed_pallas(a_packed, b: jnp.ndarray,
+                            interpret: bool = None) -> jnp.ndarray:
+    """Drop-in for ``linalg.solve_spd_packed``: packed list-of-lists ``A``
+    (batch-leading vectors) + ``b`` (n, p) -> x (n, p).
+
+    ``interpret`` defaults to True off-TPU (Pallas lowering targets
+    Mosaic; the interpreter keeps the kernel testable on the CPU mesh)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    p = len(a_packed)
+    a_rows = jnp.stack(
+        [a_packed[i][j] for i in range(p) for j in range(i + 1)]
+    )
+    x = solve_rows(a_rows, b.T, interpret=bool(interpret))
+    return x.T

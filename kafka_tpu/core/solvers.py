@@ -156,6 +156,7 @@ def kalman_update(
     x_lin: jnp.ndarray,
     x_forecast: jnp.ndarray,
     p_inv_forecast: jnp.ndarray,
+    use_pallas: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One linearised update.  Returns ``(x_analysis, A)`` where ``A`` is the
     posterior information matrix — the reference returns the Hessian as
@@ -165,6 +166,9 @@ def kalman_update(
     packed elementwise path; the dense einsum+Cholesky form is the fallback
     for large p.  The dense ``A`` is still materialised once per update for
     the information-matrix output, but nothing in the solve reads it back.
+    ``use_pallas`` routes the packed factor+solve through the hand-written
+    Pallas kernel (``core.pallas_solve``) instead of XLA-fused elementwise
+    ops.
     """
     # The unrolled assembly emits O(n_bands * p^2) traced ops; past ~32
     # bands (hyperspectral) the three-op dense einsum compiles faster.
@@ -172,7 +176,19 @@ def kalman_update(
         a_packed, b = build_normal_equations_packed(
             lin, obs, x_lin, x_forecast, p_inv_forecast
         )
-        return solve_spd_packed(a_packed, b), unpack_symmetric(a_packed)
+        if use_pallas:
+            from .pallas_solve import solve_spd_packed_pallas
+
+            x = solve_spd_packed_pallas(a_packed, b)
+        else:
+            x = solve_spd_packed(a_packed, b)
+        return x, unpack_symmetric(a_packed)
+    if use_pallas:
+        raise NotImplementedError(
+            "use_pallas covers the packed small-state path only "
+            f"(p <= {UNROLL_MAX_P}, <= 32 bands); this problem has "
+            f"p={x_forecast.shape[-1]}, {lin.jac.shape[0]} bands"
+        )
     a, b = build_normal_equations(lin, obs, x_lin, x_forecast, p_inv_forecast)
     return solve_spd_batched(a, b), a
 
@@ -191,6 +207,7 @@ def iterated_solve(
     norm_denominator: Any = None,
     hessian_forward: Any = None,
     linearize_block: Any = None,
+    use_pallas: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, SolveDiagnostics]:
     """Gauss-Newton relinearisation loop as a single ``lax.while_loop``.
 
@@ -245,7 +262,10 @@ def iterated_solve(
             )
         else:
             lin = _call_linearize(linearize, operator_params, x_prev)
-        x_new, a = kalman_update(lin, obs, x_prev, x_forecast, p_inv_forecast)
+        x_new, a = kalman_update(
+            lin, obs, x_prev, x_forecast, p_inv_forecast,
+            use_pallas=use_pallas,
+        )
         return x_new, a, lin
 
     def cond(carry):
@@ -421,7 +441,7 @@ def _blocked_linearize(linearize, operator_params, x, block: int):
     return Linearization(h0=h0[:, :n_pix], jac=jac[:, :n_pix])
 
 
-@functools.partial(jax.jit, static_argnums=(0, 6, 7))
+@functools.partial(jax.jit, static_argnums=(0, 6, 7, 8))
 def _assimilate_date_impl(
     linearize: LinearizeFn,
     obs: BandBatch,
@@ -431,12 +451,13 @@ def _assimilate_date_impl(
     solver_options: Any,
     hessian_forward: Any,
     linearize_block: Any,
+    use_pallas: bool,
 ):
     opts = dict(solver_options or {})
     return iterated_solve(
         linearize, obs, x_forecast, p_inv_forecast, operator_params,
         hessian_forward=hessian_forward, linearize_block=linearize_block,
-        **opts
+        use_pallas=use_pallas, **opts
     )
 
 
@@ -458,13 +479,16 @@ def assimilate_date_jit(
     multi-iteration program every timestep.
 
     Numeric solver options (tol, relaxation, bounds...) flow through as
-    traced values; the structural ``linearize_block`` option (it changes
-    the compiled program's shape) is split out as a static argument here.
+    traced values; structural options (``linearize_block`` — changes the
+    compiled program's shape — and ``use_pallas`` — swaps the solve
+    kernel) are split out as static arguments here.
     """
     opts = dict(solver_options or {})
     block = opts.pop("linearize_block", None)
+    use_pallas = bool(opts.pop("use_pallas", False))
     return _assimilate_date_impl(
         linearize, obs, x_forecast, p_inv_forecast, operator_params,
         opts or None, hessian_forward,
         None if block is None else int(block),
+        use_pallas,
     )
