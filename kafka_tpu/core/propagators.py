@@ -40,6 +40,21 @@ class PixelPrior(NamedTuple):
     inv_cov: jnp.ndarray
 
 
+def tip_prior_arrays() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host-side (mean, cov, inv_cov) of the JRC-TIP prior — for callers
+    that must stay off the device (e.g. synthetic problem construction,
+    where a device round-trip would poison benchmark dispatch latency)."""
+    sigma = np.array([0.12, 0.7, 0.0959, 0.15, 1.5, 0.2, 0.5])
+    x0 = np.array([0.17, 1.0, 0.1, 0.7, 2.0, 0.18, np.exp(-0.5 * 1.5)])
+    little_p = np.diag(sigma**2).astype(np.float32)
+    little_p[5, 2] = 0.8862 * 0.0959 * 0.2
+    little_p[2, 5] = 0.8862 * 0.0959 * 0.2
+    inv_p = np.linalg.inv(little_p)
+    return (
+        x0.astype(np.float32), little_p, inv_p.astype(np.float32)
+    )
+
+
 def tip_prior() -> PixelPrior:
     """The JRC-TIP prior (published two-stream inversion package prior).
 
@@ -48,12 +63,7 @@ def tip_prior() -> PixelPrior:
     mean LAI 1.5, and the single off-diagonal correlation between the NIR
     soil albedo and background terms.
     """
-    sigma = np.array([0.12, 0.7, 0.0959, 0.15, 1.5, 0.2, 0.5])
-    x0 = np.array([0.17, 1.0, 0.1, 0.7, 2.0, 0.18, np.exp(-0.5 * 1.5)])
-    little_p = np.diag(sigma**2).astype(np.float32)
-    little_p[5, 2] = 0.8862 * 0.0959 * 0.2
-    little_p[2, 5] = 0.8862 * 0.0959 * 0.2
-    inv_p = np.linalg.inv(little_p)
+    x0, little_p, inv_p = tip_prior_arrays()
     return PixelPrior(
         mean=jnp.asarray(x0, jnp.float32),
         cov=jnp.asarray(little_p, jnp.float32),

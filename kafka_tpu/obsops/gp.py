@@ -152,8 +152,10 @@ class GPBankOperator(ObservationModel):
     def __init__(self, n_params: int, n_bands: int, state_mappers=None):
         self.n_params = n_params
         self.n_bands = n_bands
+        # numpy on purpose — see TwoStreamOperator.__init__: device-array
+        # indices lower to slow dynamic gathers; host constants are static.
         self.mappers = (
-            None if state_mappers is None else jnp.asarray(state_mappers)
+            None if state_mappers is None else np.asarray(state_mappers)
         )
 
     def forward_pixel(self, aux: GPParams, x_pixel):

@@ -27,6 +27,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.types import Linearization
 
@@ -116,7 +117,9 @@ class MappedStateModel(ObservationModel):
 
     def __init__(self, inner, state_mappers, n_params: int):
         self.inner = inner
-        self.mappers = jnp.asarray(state_mappers)  # (n_bands, k)
+        # numpy on purpose — see TwoStreamOperator.__init__: device-array
+        # indices lower to slow dynamic gathers; host constants are static.
+        self.mappers = np.asarray(state_mappers)  # (n_bands, k)
         self.n_bands = int(self.mappers.shape[0])
         self.n_params = n_params
 

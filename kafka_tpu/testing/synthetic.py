@@ -79,32 +79,61 @@ class SyntheticObservations:
 
 
 def make_tip_problem(n_pix: int, seed: int = 0, sigma: float = 0.005,
-                     mask_prob: float = 0.1):
+                     mask_prob: float = 0.1, host: bool = False):
     """Standard synthetic TIP/two-stream assimilation problem used by the
     sharding tests, ``bench.py`` and ``__graft_entry__.py``: truth drawn
     around the TIP prior, two-stream forward + noise, random masking.
 
     Returns ``(operator, bands, x0, p_inv0)`` with ``x0``/``p_inv0`` the
     broadcast TIP prior (the forecast for a first-timestep assimilation).
+
+    Constructed host-side on purpose: on a tunneled TPU client the first
+    device->host copy permanently degrades every subsequent dispatch
+    (~13 ms/execution, measured), so benchmark problem setup must never
+    read back from the default device.  The synthetic forward runs on the
+    host CPU backend; only host->device transfers touch the accelerator.
     """
-    from ..core.propagators import broadcast_prior, tip_prior
+    import jax
+
+    from ..core.propagators import tip_prior_arrays
     from ..obsops.twostream import TwoStreamOperator
 
     op = TwoStreamOperator()
     rng = np.random.default_rng(seed)
-    x0, p_inv0 = broadcast_prior(tip_prior(), n_pix)
+    mean_h, _, inv_h = tip_prior_arrays()
     truth = np.clip(
-        np.asarray(x0) + rng.normal(0, 0.05, (n_pix, op.n_params)),
+        mean_h + rng.normal(0, 0.05, (n_pix, op.n_params)),
         0.05, 0.95,
     ).astype(np.float32)
-    y = np.array(op.forward(None, jnp.asarray(truth)))
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        cpu = None
+    with jax.default_device(cpu):
+        y = np.array(op.forward(None, jax.device_put(truth, cpu)))
     y += rng.normal(0, sigma, y.shape)
     mask = rng.uniform(size=y.shape) > mask_prob
     r_inv = np.where(mask, 1.0 / sigma**2, 0.0).astype(np.float32)
+    y_masked = np.where(mask, y, 0.0).astype(np.float32)
+    if host:
+        # Pure-numpy variant (identical draws): for CPU-baseline consumers
+        # that must not touch the accelerator at all.
+        bands = BandBatch(y=y_masked, r_inv=r_inv, mask=mask)
+        x0_h = np.broadcast_to(mean_h, (n_pix, op.n_params)).copy()
+        p_inv0_h = np.broadcast_to(
+            inv_h, (n_pix, op.n_params, op.n_params)
+        ).copy()
+        return op, bands, x0_h, p_inv0_h
     bands = BandBatch(
-        y=jnp.asarray(np.where(mask, y, 0.0).astype(np.float32)),
+        y=jnp.asarray(y_masked),
         r_inv=jnp.asarray(r_inv),
         mask=jnp.asarray(mask),
+    )
+    x0 = jnp.broadcast_to(
+        jnp.asarray(mean_h), (n_pix, op.n_params)
+    )
+    p_inv0 = jnp.broadcast_to(
+        jnp.asarray(inv_h), (n_pix, op.n_params, op.n_params)
     )
     return op, bands, x0, p_inv0
 
