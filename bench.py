@@ -211,6 +211,9 @@ def bench_end_to_end(ny: int = 204, nx: int = 235, n_dates: int = 3,
 
 
 def main():
+    from kafka_tpu.utils.compilation_cache import enable_compilation_cache
+
+    enable_compilation_cache()
     # Baseline on the reference's chunk size (16384 px = one 128x128
     # chunk).  vs_baseline compares both backends at that SAME size so it
     # measures the backend, not batch scaling; the headline value is the

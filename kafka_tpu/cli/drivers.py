@@ -96,7 +96,7 @@ def run_one_chunk(
     output = GeoTIFFOutput(
         cfg.parameter_list, out_gt, projection,
         folder=cfg.output_folder, prefix=prefix, epsg=epsg,
-        async_writes=True,
+        async_writes=True, wire_dtype=cfg.wire_dtype,
     )
     prior = cfg.make_prior()
     kf = KalmanFilter(
@@ -157,6 +157,9 @@ def run_config(
     reference driver, including the dask fan-out (serial loop and
     distributed execution are the same code path here;
     ``kafka_test_S2.py:196-205`` vs ``kafka_test_Py36.py:242-255``)."""
+    from ..utils.compilation_cache import enable_compilation_cache
+
+    enable_compilation_cache()
     full_mask, geo = load_state_mask(cfg)
     ny, nx = full_mask.shape
     chunks = list(get_chunks(nx, ny, tuple(cfg.chunk_size)))

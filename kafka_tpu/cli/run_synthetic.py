@@ -87,6 +87,9 @@ def _mean_prior(mean, sigma):
 
 
 def main(argv=None):
+    from ..utils.compilation_cache import enable_compilation_cache
+
+    enable_compilation_cache()
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--operator", default="twostream",
                     choices=("identity", "twostream", "wcm"))

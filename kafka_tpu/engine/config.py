@@ -139,6 +139,10 @@ class RunConfig:
     hessian_correction: bool = False
     #: double-buffered observation prefetch depth; 0 = synchronous reads
     prefetch_depth: int = 2
+    #: device->host wire format for output rasters ("float16" halves the
+    #: transfer bytes at <=2^-11 relative quantisation; "float32" is
+    #: bit-exact — see ``io.output.GeoTIFFOutput``)
+    wire_dtype: str = "float16"
     solver_options: Optional[dict] = None
     #: folder for per-timestep state checkpoints (packed-triangle .npz,
     #: prefixed per chunk).  A restarted run resumes each unfinished chunk

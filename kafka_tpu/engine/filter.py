@@ -174,10 +174,17 @@ class KalmanFilter:
             )
             p_a = None
             if self.diagnostics:
+                # One packed read: each device->host round-trip costs
+                # ~0.2 s of latency on a tunneled chip, so the two
+                # diagnostic scalars travel together.
+                packed = np.asarray(jnp.stack([
+                    jnp.asarray(diags.n_iterations, jnp.float32),
+                    jnp.asarray(diags.convergence_norm, jnp.float32),
+                ]))
                 rec = {
                     "date": date,
-                    "n_iterations": int(diags.n_iterations),
-                    "convergence_norm": float(diags.convergence_norm),
+                    "n_iterations": int(packed[0]),
+                    "convergence_norm": float(packed[1]),
                     "wall_s": time.time() - t0,
                 }
                 self.diagnostics_log.append(rec)

@@ -1,0 +1,51 @@
+"""Persistent XLA compilation cache.
+
+TPU compiles are expensive (~10 s for the per-date assimilation program,
+and ~0.5 s even for trivial eager ops through a tunneled chip), and the
+reference-scale workloads re-run the same programs across processes —
+chunked drivers, restarts, repeated measurements.  Enabling JAX's
+persistent compilation cache makes every compile after the first process
+a disk hit.
+
+Called by the CLI drivers, ``bench.py`` and the measurement harness; safe
+to call multiple times.  Opt out with ``KAFKA_TPU_NO_COMPILE_CACHE=1`` or
+redirect with ``KAFKA_TPU_COMPILE_CACHE_DIR``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+LOG = logging.getLogger(__name__)
+
+_DEFAULT_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "kafka_tpu", "xla"
+)
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Point JAX at a persistent on-disk compilation cache.
+
+    Returns the cache directory, or ``None`` when disabled (env opt-out
+    or a JAX without the config knobs)."""
+    if os.environ.get("KAFKA_TPU_NO_COMPILE_CACHE"):
+        return None
+    import jax
+
+    path = (
+        cache_dir
+        or os.environ.get("KAFKA_TPU_COMPILE_CACHE_DIR")
+        or _DEFAULT_DIR
+    )
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # Cache everything: the eager-op compiles a run performs once per
+        # process are exactly the ones worth never repeating.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except (AttributeError, ValueError, OSError) as e:
+        LOG.info("compilation cache unavailable: %s", e)
+        return None
+    return path

@@ -184,6 +184,9 @@ def _run_joint(size, chunk, n_s2, n_s1, keep=None):
 
 
 def main():
+    from kafka_tpu.utils.compilation_cache import enable_compilation_cache
+
+    enable_compilation_cache()
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("mode",
                     choices=["barrax", "tile", "annual", "joint", "oracle"])
