@@ -107,6 +107,7 @@ def run_one_chunk(
         solver_options=cfg.solver_options,
         hessian_correction=cfg.hessian_correction,
         prefetch_depth=cfg.prefetch_depth,
+        scan_window=cfg.scan_window,
     )
     kf.set_trajectory_model()
     q = cfg.q_diag if cfg.q_diag is not None else np.zeros(cfg.n_params)

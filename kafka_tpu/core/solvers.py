@@ -492,3 +492,99 @@ def assimilate_date_jit(
         None if block is None else int(block),
         use_pallas,
     )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 9, 11, 12))
+def _assimilate_scan_impl(
+    linearize: LinearizeFn,
+    obs_stacked: BandBatch,
+    x_analysis0: jnp.ndarray,
+    p_inv_analysis0: jnp.ndarray,
+    aux_stacked: Any,
+    m_matrix: jnp.ndarray,
+    q_diag: jnp.ndarray,
+    prior_mean: Any,
+    prior_inv: Any,
+    state_propagator: Any,
+    solver_options: Any,
+    hessian_forward: Any,
+    linearize_block: Any,
+):
+    from .linalg import batched_diagonal, spd_inverse_batched
+    from .propagators import advance as advance_fn
+
+    opts = dict(solver_options or {})
+
+    def step(carry, inp):
+        x_a, p_inv_a = carry
+        bands_k, aux_k = inp
+        x_f, p_f, p_f_inv = advance_fn(
+            x_a, None, p_inv_a, m_matrix, q_diag,
+            prior_mean=prior_mean, prior_cov_inverse=prior_inv,
+            state_propagator=state_propagator,
+        )
+        if p_f_inv is None:
+            p_f_inv = spd_inverse_batched(p_f)
+        x_n, p_inv_n, diags = iterated_solve(
+            linearize, bands_k, x_f, p_f_inv, aux_k,
+            hessian_forward=hessian_forward,
+            linearize_block=linearize_block, **opts
+        )
+        out = (
+            x_n, batched_diagonal(p_inv_n),
+            diags.n_iterations, diags.convergence_norm,
+        )
+        return (x_n, p_inv_n), out
+
+    (x_fin, p_inv_fin), (xs, diag_s, iters, norms) = jax.lax.scan(
+        step, (x_analysis0, p_inv_analysis0), (obs_stacked, aux_stacked)
+    )
+    return x_fin, p_inv_fin, xs, diag_s, iters, norms
+
+
+def assimilate_windows_scan(
+    linearize: LinearizeFn,
+    obs_stacked: BandBatch,
+    x_analysis0: jnp.ndarray,
+    p_inv_analysis0: jnp.ndarray,
+    aux_stacked: Any = None,
+    m_matrix: jnp.ndarray = None,
+    q_diag: jnp.ndarray = None,
+    prior_mean: Any = None,
+    prior_inv: Any = None,
+    state_propagator: Any = None,
+    solver_options: Any = None,
+    hessian_forward: Any = None,
+):
+    """K consecutive advance→assimilate windows as ONE device program.
+
+    The temporal axis of SURVEY §2.3 mapped onto ``lax.scan``: each step
+    advances the previous analysis (propagator and/or prior blend, the
+    ``propagate_and_blend_prior`` semantics) and runs the full Gauss-Newton
+    assimilation of that window's observations.  The host dispatches once
+    per K windows instead of once per date, and the per-window analyses
+    come back as two stacked arrays — on a slow device link that turns K
+    round-trips into one.
+
+    ``obs_stacked`` is a ``BandBatch`` with a leading window axis
+    ``(K, n_bands, n_pix)``; ``aux_stacked`` a pytree whose array leaves
+    carry the same leading axis.  The prior (if any) must be
+    time-invariant across the K windows — the engine only fuses windows
+    whose prior declares ``date_invariant``.
+
+    Returns ``(x_final, p_inv_final, xs (K, n, p), p_inv_diags (K, n, p),
+    n_iterations (K,), convergence_norms (K,))``.
+    """
+    opts = dict(solver_options or {})
+    block = opts.pop("linearize_block", None)
+    opts.pop("use_pallas", None)  # structural; not supported under scan
+    if m_matrix is None:
+        m_matrix = jnp.eye(x_analysis0.shape[-1], dtype=jnp.float32)
+    if q_diag is None:
+        q_diag = jnp.zeros((x_analysis0.shape[-1],), jnp.float32)
+    return _assimilate_scan_impl(
+        linearize, obs_stacked, x_analysis0, p_inv_analysis0, aux_stacked,
+        m_matrix, q_diag, prior_mean, prior_inv, state_propagator,
+        opts or None, hessian_forward,
+        None if block is None else int(block),
+    )

@@ -143,6 +143,9 @@ class RunConfig:
     #: transfer bytes at <=2^-11 relative quantisation; "float32" is
     #: bit-exact — see ``io.output.GeoTIFFOutput``)
     wire_dtype: str = "float16"
+    #: temporal fusion: consecutive single-observation windows run as one
+    #: lax.scan program in blocks of up to this many; 1 disables
+    scan_window: int = 8
     solver_options: Optional[dict] = None
     #: folder for per-timestep state checkpoints (packed-triangle .npz,
     #: prefixed per chunk).  A restarted run resumes each unfinished chunk

@@ -19,6 +19,7 @@ import logging
 import time
 from typing import Any, Callable, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -63,6 +64,7 @@ class KalmanFilter:
         solver_options: Optional[dict] = None,
         hessian_correction: bool = False,
         prefetch_depth: int = 2,
+        scan_window: int = 8,
     ):
         self.observations = observations
         self.output = output
@@ -83,6 +85,16 @@ class KalmanFilter:
         # (linear_kf.py:225-227).
         self.prefetch_depth = int(prefetch_depth)
         self._prefetcher = None
+        # Temporal fusion: up to this many consecutive single-observation
+        # windows run as ONE lax.scan program (advance + Gauss-Newton per
+        # step), with the per-window analyses returned as two stacked
+        # arrays — one dispatch and one device->host round-trip per block
+        # instead of per date.  1 disables fusion (the reference's
+        # strictly host-driven loop).
+        self.scan_window = max(1, int(scan_window))
+        # Observations fetched while probing a fusion block but consumed
+        # by the unfused path instead (prefetcher dates pop exactly once).
+        self._pending_obs: dict = {}
         self.diagnostics = diagnostics
         self.diagnostics_log: list = []
         # Identity trajectory model + zero model error by default, matching
@@ -131,6 +143,15 @@ class KalmanFilter:
             state_propagator=self._state_propagator,
         )
 
+    def _fetch(self, date) -> DateObservation:
+        if self._pending_obs:
+            hit = self._pending_obs.pop(date, None)
+            if hit is not None:
+                return hit
+        if self._prefetcher is not None:
+            return self._prefetcher.get(date)
+        return self.observations.get_observations(date, self.gather)
+
     def assimilate_dates(self, dates, x_forecast, p_forecast,
                          p_forecast_inverse):
         """Assimilate each acquisition in the window sequentially, posterior
@@ -142,10 +163,7 @@ class KalmanFilter:
             # P^-1; the solver works in information space.
             p_inv_a = spd_inverse_batched(jnp.asarray(p_a, jnp.float32))
         for date in dates:
-            if self._prefetcher is not None:
-                obs = self._prefetcher.get(date)
-            else:
-                obs = self.observations.get_observations(date, self.gather)
+            obs = self._fetch(date)
             t0 = time.time()
             opts = dict(self.solver_options or {})
             if "state_bounds" not in opts and \
@@ -225,9 +243,17 @@ class KalmanFilter:
             plan = [d for _, locate_times, _ in windows
                     for d in locate_times]
             if plan:
+                # Temporal fusion collects a whole block of observations
+                # before dispatching the scan; a shallower prefetch would
+                # serialise those reads against the device instead of
+                # overlapping them with the previous block's solve.  Runs
+                # that can never fuse keep the configured depth.
+                depth = self.prefetch_depth
+                if self._fusion_possible():
+                    depth = max(depth, self.scan_window)
                 self._prefetcher = ObservationPrefetcher(
                     self.observations, self.gather, plan,
-                    depth=self.prefetch_depth,
+                    depth=depth,
                 )
         try:
             with trace(profile_dir):
@@ -240,61 +266,282 @@ class KalmanFilter:
                 self._prefetcher.close()
                 self._prefetcher = None
 
+    # ------------------------------------------------------------------
+    # temporal fusion (lax.scan over consecutive windows)
+    # ------------------------------------------------------------------
+
+    # Device-memory guards for a fused block: K*n*p elements for each of
+    # the two stacked result arrays, K*B*n per stacked band array, and the
+    # stacked aux bytes (an aux bank identical across dates would be
+    # replicated K times — refuse rather than blow HBM).
+    _SCAN_MAX_STATE_ELEMS = 100_000_000
+    _SCAN_MAX_BAND_ELEMS = 100_000_000
+    _SCAN_MAX_AUX_BYTES = 64 * 1024 * 1024
+
+    def _fusion_possible(self) -> bool:
+        """Engine-level fusability: a date-invariant (or absent) prior, and
+        no opt-in Pallas kernel (structural option the scan path does not
+        carry — silently dropping it would be worse than not fusing)."""
+        if self.scan_window <= 1:
+            return False
+        if (self.solver_options or {}).get("use_pallas"):
+            return False
+        return self.prior is None or bool(
+            getattr(self.prior, "date_invariant", False)
+        )
+
+    @staticmethod
+    def _aux_leaves(aux):
+        leaves, treedef = jax.tree.flatten(aux)
+        return treedef, leaves
+
+    def _stackable(self, first: DateObservation,
+                   other: DateObservation) -> bool:
+        if other.operator is not first.operator:
+            return False
+        if other.bands.y.shape != first.bands.y.shape:
+            return False
+        td_a, la = self._aux_leaves(first.aux)
+        td_b, lb = self._aux_leaves(other.aux)
+        if td_a != td_b or len(la) != len(lb):
+            return False
+        for a, b in zip(la, lb):
+            sa = np.shape(a)
+            if sa != np.shape(b):
+                return False
+        return True
+
+    def _block_fits(self, obs: DateObservation, k: int) -> bool:
+        n, p = self.gather.n_pad, self.n_params
+        if k * n * p > self._SCAN_MAX_STATE_ELEMS:
+            return False
+        # Three stacked band arrays (y, r_inv, mask) are materialised.
+        if 3 * k * int(np.prod(obs.bands.y.shape)) > \
+                self._SCAN_MAX_BAND_ELEMS:
+            return False
+        _, leaves = self._aux_leaves(obs.aux)
+        aux_bytes = sum(
+            int(np.prod(np.shape(a)) or 1)
+            * int(getattr(getattr(a, "dtype", None), "itemsize", 4))
+            for a in leaves
+        )
+        return k * aux_bytes <= self._SCAN_MAX_AUX_BYTES
+
+    def _run_fused_block(self, block, x_analysis, p_analysis,
+                         p_analysis_inverse, checkpointer):
+        """Run K collected (timestep, obs) windows as one scan program."""
+        from ..core.solvers import assimilate_windows_scan
+
+        p_inv = p_analysis_inverse
+        if p_inv is None and p_analysis is not None:
+            p_inv = spd_inverse_batched(
+                jnp.asarray(p_analysis, jnp.float32)
+            )
+        prior_mean = prior_inv = None
+        if self.prior is not None:
+            prior_mean, prior_inv = self.prior.process_prior(
+                block[0][0], self.gather
+            )
+        first = block[0][1]
+        opts = dict(self.solver_options or {})
+        if "state_bounds" not in opts and \
+                getattr(first.operator, "state_bounds", None) is not None:
+            lo, hi = first.operator.state_bounds
+            opts["state_bounds"] = (
+                jnp.asarray(lo, jnp.float32), jnp.asarray(hi, jnp.float32)
+            )
+        opts.setdefault(
+            "norm_denominator",
+            float(self.gather.n_valid * self.n_params),
+        )
+        if self.gather.n_pad > 262144:
+            opts.setdefault("linearize_block", 262144)
+        hess_fwd = None
+        if self.hessian_correction:
+            hess_fwd = getattr(first.operator, "forward_pixel", None)
+
+        t0 = time.time()
+        bands = BandBatch(
+            y=jnp.stack([o.bands.y for _, o in block]),
+            r_inv=jnp.stack([o.bands.r_inv for _, o in block]),
+            mask=jnp.stack([o.bands.mask for _, o in block]),
+        )
+        aux_stacked = None
+        if first.aux is not None:
+            aux_stacked = jax.tree.map(
+                lambda *ls: jnp.stack([jnp.asarray(l) for l in ls]),
+                *[o.aux for _, o in block],
+            )
+        x_fin, p_inv_fin, xs, diag_s, iters, norms = (
+            assimilate_windows_scan(
+                first.operator.linearize, bands, x_analysis, p_inv,
+                aux_stacked, self.trajectory_model,
+                self.trajectory_uncertainty, prior_mean, prior_inv,
+                self._state_propagator, opts or None, hess_fwd,
+            )
+        )
+        timesteps = [ts for ts, _ in block]
+        with annotate("kafka/dump"):
+            dump_block = getattr(self.output, "dump_block", None)
+            if dump_block is not None:
+                dump_block(timesteps, xs, diag_s, self.gather,
+                           self.parameter_list)
+            else:
+                for k, ts in enumerate(timesteps):
+                    self.output.dump_data(
+                        ts, xs[k], diag_s[k], self.gather,
+                        self.parameter_list,
+                    )
+        if self.diagnostics:
+            packed = np.asarray(jnp.concatenate([
+                jnp.asarray(iters, jnp.float32),
+                jnp.asarray(norms, jnp.float32),
+            ]))
+            k = len(timesteps)
+            wall = time.time() - t0
+            for j, ts in enumerate(timesteps):
+                self.diagnostics_log.append({
+                    "date": ts,
+                    "n_iterations": int(packed[j]),
+                    "convergence_norm": float(packed[k + j]),
+                    "wall_s": wall / k,
+                    "fused": k,
+                })
+            LOG.info(
+                "Assimilated %d fused windows ending %s in %.2fs",
+                k, timesteps[-1], wall,
+            )
+        if checkpointer is not None:
+            flush = getattr(self.output, "flush", None)
+            if flush is not None:
+                flush()
+            checkpointer.save(timesteps[-1], x_fin, p_inv_fin)
+        return x_fin, None, p_inv_fin
+
     def _run_loop(self, windows, x_forecast, p_forecast,
                   p_forecast_inverse, checkpointer, advance_first):
         x_analysis, p_analysis, p_analysis_inverse = (
             x_forecast, p_forecast, p_forecast_inverse
         )
-        for timestep, locate_times, is_first in windows:
-            if (not is_first) or advance_first:
-                LOG.info("Advancing state to %s", timestep)
-                with annotate("kafka/advance"):
-                    x_forecast, p_forecast, p_forecast_inverse = (
-                        self.advance(
-                            x_analysis, p_analysis, p_analysis_inverse,
-                            timestep,
-                        )
+        self._pending_obs = {}
+        idx = 0
+        while idx < len(windows):
+            timestep, locate_times, is_first = windows[idx]
+            # Try to collect a run of fusable windows: each advances, holds
+            # exactly one acquisition, and stacks with the block head.
+            if (
+                self._fusion_possible()
+                and ((not is_first) or advance_first)
+                and len(locate_times) == 1
+            ):
+                block, block_dates = [], []
+                j = idx
+                while j < len(windows) and len(block) < self.scan_window:
+                    ts_j, lt_j, _ = windows[j]
+                    if len(lt_j) != 1:
+                        break
+                    obs_j = self._fetch(lt_j[0])
+                    if (block and not self._stackable(block[0][1], obs_j)) \
+                            or not self._block_fits(obs_j, len(block) + 1):
+                        self._pending_obs[lt_j[0]] = obs_j
+                        break
+                    block.append((ts_j, obs_j))
+                    block_dates.append(lt_j[0])
+                    j += 1
+                # Bucket the block length to a power of two: the scan
+                # program recompiles per distinct K, so free-running block
+                # sizes (broken by sensor changes, grid gaps...) would each
+                # pay a fresh multi-second XLA compile.  Trimmed windows
+                # return their fetched observations via _pending_obs.
+                k_bucket = 1
+                while k_bucket * 2 <= len(block):
+                    k_bucket *= 2
+                for (ts_j, obs_j), date_j in zip(
+                    block[k_bucket:], block_dates[k_bucket:]
+                ):
+                    self._pending_obs[date_j] = obs_j
+                block = block[:k_bucket]
+                if len(block) >= 2:
+                    LOG.info(
+                        "Advancing + assimilating %d fused windows "
+                        "%s..%s", len(block), block[0][0], block[-1][0],
                     )
-            if len(locate_times) == 0:
-                LOG.info("No observations in window ending %s", timestep)
-                x_analysis = x_forecast
-                p_analysis = p_forecast
-                p_analysis_inverse = p_forecast_inverse
-            else:
-                with annotate("kafka/assimilate"):
-                    x_analysis, p_analysis, p_analysis_inverse = (
-                        self.assimilate_dates(
-                            locate_times, x_forecast, p_forecast,
-                            p_forecast_inverse,
+                    with annotate("kafka/fused_scan"):
+                        x_analysis, p_analysis, p_analysis_inverse = (
+                            self._run_fused_block(
+                                block, x_analysis, p_analysis,
+                                p_analysis_inverse, checkpointer,
+                            )
                         )
-                    )
-            p_inv_diag = self._information_diagonal(
-                p_analysis, p_analysis_inverse
-            )
-            with annotate("kafka/dump"):
-                # x/diag stay device arrays: an async writer then pays the
-                # device->host transfer on its own thread, off the loop.
-                self.output.dump_data(
-                    timestep, x_analysis, p_inv_diag,
-                    self.gather, self.parameter_list,
+                    idx += len(block)
+                    continue
+                if len(block) == 1:
+                    # Hand the fetched observation to the unfused path.
+                    self._pending_obs[locate_times[0]] = block[0][1]
+            x_analysis, p_analysis, p_analysis_inverse = (
+                self._run_one_window(
+                    windows[idx], x_analysis, p_analysis,
+                    p_analysis_inverse, checkpointer, advance_first,
                 )
-            if checkpointer is not None:
-                # A checkpoint asserts "everything up to this timestep is
-                # durable": drain any queued async GeoTIFF writes first,
-                # else a crash between save and the writer thread loses
-                # outputs that resume will never re-create.
-                flush = getattr(self.output, "flush", None)
-                if flush is not None:
-                    flush()
-                # Persist in information form regardless of propagator:
-                # covariance-form steps (standard Kalman) hand back P,
-                # which would otherwise be dropped on resume.
-                p_inv_ck = p_analysis_inverse
-                if p_inv_ck is None and p_analysis is not None:
-                    p_inv_ck = spd_inverse_batched(
-                        jnp.asarray(p_analysis, jnp.float32)
+            )
+            idx += 1
+        return x_analysis, p_analysis, p_analysis_inverse
+
+    def _run_one_window(self, window, x_analysis, p_analysis,
+                        p_analysis_inverse, checkpointer, advance_first):
+        timestep, locate_times, is_first = window
+        x_forecast, p_forecast, p_forecast_inverse = (
+            x_analysis, p_analysis, p_analysis_inverse
+        )
+        if (not is_first) or advance_first:
+            LOG.info("Advancing state to %s", timestep)
+            with annotate("kafka/advance"):
+                x_forecast, p_forecast, p_forecast_inverse = (
+                    self.advance(
+                        x_analysis, p_analysis, p_analysis_inverse,
+                        timestep,
                     )
-                checkpointer.save(timestep, x_analysis, p_inv_ck)
+                )
+        if len(locate_times) == 0:
+            LOG.info("No observations in window ending %s", timestep)
+            x_analysis = x_forecast
+            p_analysis = p_forecast
+            p_analysis_inverse = p_forecast_inverse
+        else:
+            with annotate("kafka/assimilate"):
+                x_analysis, p_analysis, p_analysis_inverse = (
+                    self.assimilate_dates(
+                        locate_times, x_forecast, p_forecast,
+                        p_forecast_inverse,
+                    )
+                )
+        p_inv_diag = self._information_diagonal(
+            p_analysis, p_analysis_inverse
+        )
+        with annotate("kafka/dump"):
+            # x/diag stay device arrays: an async writer then pays the
+            # device->host transfer on its own thread, off the loop.
+            self.output.dump_data(
+                timestep, x_analysis, p_inv_diag,
+                self.gather, self.parameter_list,
+            )
+        if checkpointer is not None:
+            # A checkpoint asserts "everything up to this timestep is
+            # durable": drain any queued async GeoTIFF writes first,
+            # else a crash between save and the writer thread loses
+            # outputs that resume will never re-create.
+            flush = getattr(self.output, "flush", None)
+            if flush is not None:
+                flush()
+            # Persist in information form regardless of propagator:
+            # covariance-form steps (standard Kalman) hand back P,
+            # which would otherwise be dropped on resume.
+            p_inv_ck = p_analysis_inverse
+            if p_inv_ck is None and p_analysis is not None:
+                p_inv_ck = spd_inverse_batched(
+                    jnp.asarray(p_analysis, jnp.float32)
+                )
+            checkpointer.save(timestep, x_analysis, p_inv_ck)
         return x_analysis, p_analysis, p_analysis_inverse
 
     @staticmethod

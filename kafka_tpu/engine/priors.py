@@ -45,6 +45,10 @@ KERNEL_PARAMETER_LIST = kernel_parameter_list(7)
 class FixedGaussianPrior:
     """A time-invariant i.i.d.-per-pixel Gaussian prior."""
 
+    #: safe to reuse one ``process_prior`` result across fused scan
+    #: windows (engine temporal fusion)
+    date_invariant = True
+
     def __init__(self, prior: PixelPrior,
                  parameter_list: Sequence[str]):
         self.prior = prior

@@ -150,6 +150,32 @@ class GeoTIFFOutput:
             self._write_all(timestep, x, unc, gather, parameter_list,
                             unc_is_sigma)
 
+    def dump_block(self, timesteps, xs, p_inv_diags,
+                   gather: PixelGather, parameter_list) -> None:
+        """Dump K consecutive timesteps from stacked ``(K, n, p)`` arrays
+        (the engine's temporal-fusion path): ONE wire conversion and one
+        pair of device->host transfers covers the whole block."""
+        self._raise_pending()
+        xs, uncs, unc_is_sigma = self._to_wire(xs, p_inv_diags)
+        item = (
+            tuple(timesteps), self._snapshot(xs), self._snapshot(uncs),
+            gather, tuple(parameter_list), unc_is_sigma,
+        )
+        if self._queue is not None:
+            self._queue.put(("block",) + item)
+        else:
+            self._write_block(*item)
+
+    def _write_block(self, timesteps, xs, uncs, gather, parameter_list,
+                     unc_is_sigma=False):
+        xs = np.asarray(xs)
+        uncs = None if uncs is None else np.asarray(uncs)
+        for k, ts in enumerate(timesteps):
+            self._write_all(
+                ts, xs[k], None if uncs is None else uncs[k],
+                gather, parameter_list, unc_is_sigma,
+            )
+
     @staticmethod
     def _snapshot(arr):
         if arr is None or not isinstance(arr, np.ndarray):
@@ -162,7 +188,10 @@ class GeoTIFFOutput:
             if item is None:
                 return
             try:
-                self._write_all(*item)
+                if item[0] == "block":
+                    self._write_block(*item[1:])
+                else:
+                    self._write_all(*item)
             except Exception as exc:  # surfaced on next dump/flush/close
                 self._error = exc
             finally:
