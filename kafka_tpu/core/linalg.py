@@ -134,14 +134,38 @@ def solve_spd_batched(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return x[..., 0]
 
 
-def solve_batched(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+def solve_batched(a: jnp.ndarray, b: jnp.ndarray,
+                  block: int = None) -> jnp.ndarray:
     """General batched solve (LU) for non-symmetric per-pixel systems.
 
     Needed by the exact information-filter propagator, which solves
     ``(I + P_inv Q) X = P_inv`` where the left side is not symmetric
     (``kf_tools.py:240-242``).
+
+    ``block`` bounds the batch slice handed to XLA's LU custom call at a
+    time (via ``lax.map``): the pivoted-LU lowering allocates HLO temps
+    of several times the operand size, which at millions of pixels OOMs
+    the chip — especially inside a fused temporal scan where the rest of
+    the program's buffers are live too.  Padding blocks are identity
+    systems, so every slice stays non-singular.
     """
-    return jnp.linalg.solve(a, b)
+    n = a.shape[0]
+    if block is None or n <= block:
+        return jnp.linalg.solve(a, b)
+    nb = -(-n // block)
+    pad = nb * block - n
+    if pad:
+        eye = jnp.broadcast_to(
+            jnp.eye(a.shape[-1], dtype=a.dtype), (pad,) + a.shape[1:]
+        )
+        a = jnp.concatenate([a, eye], axis=0)
+        b = jnp.concatenate(
+            [b, jnp.zeros((pad,) + b.shape[1:], b.dtype)], axis=0
+        )
+    a = a.reshape((nb, block) + a.shape[1:])
+    b = b.reshape((nb, block) + b.shape[1:])
+    out = jax.lax.map(lambda ab: jnp.linalg.solve(ab[0], ab[1]), (a, b))
+    return out.reshape((nb * block,) + out.shape[2:])[:n]
 
 
 def spd_inverse_batched(a: jnp.ndarray) -> jnp.ndarray:

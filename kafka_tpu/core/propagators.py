@@ -31,6 +31,12 @@ from .linalg import (
     spd_inverse_batched,
 )
 
+# Pixel-batch slice per LU call in the exact information propagator: the
+# XLA LU custom call's HLO temps are several times the operand, so the
+# full-tile batch must not hit it in one piece (OOMs a 16 GB chip at
+# ~1M pixels inside a fused scan).
+INFO_SOLVE_BLOCK = 131072
+
 
 class PixelPrior(NamedTuple):
     """A per-pixel i.i.d. Gaussian prior: mean (p,), cov + inverse (p, p)."""
@@ -122,7 +128,9 @@ def propagate_information_filter(x_analysis, p_analysis, p_analysis_inverse,
     # S = P_inv Q with diagonal Q: scale columns.
     s = p_analysis_inverse * q[:, None, :]
     a = jnp.eye(p, dtype=x_analysis.dtype) + s
-    p_forecast_inverse = solve_batched(a, p_analysis_inverse)
+    p_forecast_inverse = solve_batched(
+        a, p_analysis_inverse, block=INFO_SOLVE_BLOCK
+    )
     return x_forecast, None, p_forecast_inverse
 
 

@@ -8,8 +8,15 @@ Reproduces the observation semantics of the reference's ``S1Observations``
 - two bands: VV then VH, read from the ``sigma0_VV``/``sigma0_VH``
   variables (``:172-179``);
 - -999 treated as missing (``:24,134-152``);
-- 5% relative uncertainty placeholder (ENL refinement is the reference's
-  open TODO, ``:106-132``) stored as inverse variance (``:182-188``);
+- uncertainty stored as inverse variance (``:182-188``).  The reference
+  ships a 5% relative placeholder with ENL refinement as an open TODO
+  (``:106-132``); here the TODO is implemented: with an equivalent
+  number of looks ``enl`` (constructor argument, or an ``enl`` attribute
+  in the file), speckle statistics give
+  ``sigma = sqrt(sigma0^2 / ENL + noise_floor^2)`` per pixel (gamma-
+  distributed multi-looked intensity: std = mean/sqrt(L), plus the
+  instrument's noise-equivalent sigma0 floor).  Without an ENL the 5%
+  placeholder is preserved;
 - the per-pixel incidence angle ``theta`` warped to the state grid and
   carried to the operator (``:191-195`` — there a TODO, here implemented:
   the WCM aux takes the real angle raster instead of the hard-coded 23
@@ -91,10 +98,19 @@ class S1Observations:
         state_geo,
         operator: Optional[Any] = None,
         relative_uncertainty: float = 0.05,
+        enl: Optional[float] = None,
+        noise_floor: float = 0.0,
     ):
         self.state_geotransform, self.state_crs = state_geo
         self.operator = operator if operator is not None else WCMOperator()
         self.relative_uncertainty = float(relative_uncertainty)
+        #: equivalent number of looks for speckle-statistics uncertainty;
+        #: None = use the file's ``enl`` attribute, or fall back to the
+        #: reference's relative placeholder.
+        self.enl = None if enl is None else float(enl)
+        #: noise-equivalent sigma0 (linear power units) added in
+        #: quadrature to the speckle term.
+        self.noise_floor = float(noise_floor)
         files = sorted(glob.glob(os.path.join(data_folder, "*.nc")))
         self.dates: List[datetime.datetime] = []
         self.date_data: Dict[datetime.datetime, str] = {}
@@ -111,6 +127,8 @@ class S1Observations:
         # One warp mapping per (source grid, dst shape) — shared by
         # VV/VH/theta of a scene (see sentinel2.py mapping cache).
         self._mapping_cache: Dict[tuple, tuple] = {}
+        # File-level ``enl`` attributes are immutable: read once per path.
+        self._enl_cache: Dict[str, Optional[float]] = {}
 
     def define_output(self):
         return self.state_crs, list(self.state_geotransform)
@@ -128,9 +146,23 @@ class S1Observations:
         col_f, row_f = self._mapping_cache[key]
         return resample(arr, col_f, row_f, method="nearest", nodata=nodata)
 
+    def _file_enl(self, path: str) -> Optional[float]:
+        if path in self._enl_cache:
+            return self._enl_cache[path]
+        import h5py
+
+        with h5py.File(path, "r") as f:
+            enl = (
+                float(np.asarray(f.attrs["enl"]).ravel()[0])
+                if "enl" in f.attrs else None
+            )
+        self._enl_cache[path] = enl
+        return enl
+
     def get_observations(self, date, gather: PixelGather) -> DateObservation:
         path = self.date_data[date]
         dst_shape = gather.mask.shape
+        enl = self.enl if self.enl is not None else self._file_enl(path)
         ys, r_invs, masks = [], [], []
         for pol in POLARISATIONS:
             sigma0 = self._warp_var(
@@ -140,8 +172,20 @@ class S1Observations:
             mask = (
                 (pix != MISSING_VALUE) & np.isfinite(pix) & gather.valid
             )
+            # Linear-power backscatter must be strictly positive to carry
+            # information (negative values appear in noise-subtracted GRD
+            # products): both uncertainty models reject y <= 0, matching
+            # the relative path's implicit sigma > 0 gate.
+            mask &= pix > 0
             y = np.where(mask, pix, 0.0).astype(np.float32)
-            sigma = self.relative_uncertainty * y
+            if enl is not None:
+                # Multi-looked intensity speckle: std = sigma0/sqrt(L),
+                # noise floor in quadrature.
+                sigma = np.sqrt(
+                    y * y / enl + self.noise_floor**2
+                ).astype(np.float32)
+            else:
+                sigma = self.relative_uncertainty * y
             with np.errstate(divide="ignore", invalid="ignore"):
                 r_inv = np.where(mask & (sigma > 0), 1.0 / sigma**2, 0.0)
             ys.append(y)

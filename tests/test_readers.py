@@ -167,6 +167,49 @@ class TestSentinel2:
         assert build(meta, None) == "c"
 
 
+class TestS1EnlUncertainty:
+    def test_enl_speckle_uncertainty(self, tmp_path):
+        import h5py
+
+        fname = "S1A_IW_GRDH_1SDV_pre_20170705T175515_y_z.nc"
+        _make_s1_file(str(tmp_path / fname))
+        gather = make_pixel_gather(np.ones((NY, NX), bool), pad_multiple=64)
+
+        # constructor ENL wins; sigma^2 = y^2/L + nesz^2
+        s1 = S1Observations(str(tmp_path), (GT, 32630), enl=4.4,
+                            noise_floor=1e-3)
+        obs = s1.get_observations(s1.dates[0], gather)
+        y = np.asarray(obs.bands.y[0])
+        r_inv = np.asarray(obs.bands.r_inv[0])
+        mask = np.asarray(obs.bands.mask[0])
+        expect = 1.0 / (y[mask] ** 2 / 4.4 + 1e-6)
+        np.testing.assert_allclose(r_inv[mask], expect, rtol=1e-5)
+        assert np.all(r_inv[~mask] == 0)
+
+        # file-level enl attribute used when the constructor gives none
+        with h5py.File(str(tmp_path / fname), "a") as f:
+            f.attrs["enl"] = 9.0
+        s1b = S1Observations(str(tmp_path), (GT, 32630))
+        obs_b = s1b.get_observations(s1b.dates[0], gather)
+        r_inv_b = np.asarray(obs_b.bands.r_inv[0])
+        np.testing.assert_allclose(
+            r_inv_b[mask], 9.0 / y[mask] ** 2, rtol=1e-5
+        )
+
+    def test_no_enl_keeps_relative_placeholder(self, tmp_path):
+        fname = "S1A_IW_GRDH_1SDV_pre_20170705T175515_y_z.nc"
+        _make_s1_file(str(tmp_path / fname))
+        gather = make_pixel_gather(np.ones((NY, NX), bool), pad_multiple=64)
+        s1 = S1Observations(str(tmp_path), (GT, 32630))
+        obs = s1.get_observations(s1.dates[0], gather)
+        y = np.asarray(obs.bands.y[0])
+        mask = np.asarray(obs.bands.mask[0])
+        np.testing.assert_allclose(
+            np.asarray(obs.bands.r_inv[0])[mask],
+            1.0 / (0.05 * y[mask]) ** 2, rtol=1e-5,
+        )
+
+
 class TestS1ThetaFallback:
     def test_missing_theta_defaults_to_23deg(self, tmp_path):
         import h5py

@@ -18,7 +18,7 @@ Modes
 - ``oracle``  — the reference algorithm (SciPy sparse + SuperLU) on this
   host's CPU for px/s context (same solve, no I/O — generous to it).
 
-Usage: ``python tools/measure_baseline.py tile --size 10980 --chunk 2196``
+Usage: ``python tools/measure_baseline.py tile --size 10980 --chunk 1098``
 """
 
 from __future__ import annotations
@@ -191,7 +191,10 @@ def main():
     ap.add_argument("mode",
                     choices=["barrax", "tile", "annual", "joint", "oracle"])
     ap.add_argument("--size", type=int, default=None)
-    ap.add_argument("--chunk", type=int, default=2196)
+    # 1098^2 px/chunk: a 2196^2 PROSAIL chunk (4.8M px) exceeds the v5e
+    # 16 GB HBM budget (the (n,p,p) information matrices alone are ~2 GB
+    # each and several are live through the solve).
+    ap.add_argument("--chunk", type=int, default=1098)
     ap.add_argument("--dates", type=int, default=None)
     ap.add_argument("--step-days", type=int, default=2)
     ap.add_argument("--oracle-n", type=int, default=16384)
