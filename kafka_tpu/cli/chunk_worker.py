@@ -1,0 +1,59 @@
+"""Single-chunk subprocess worker for device-OOM recovery.
+
+On this class of TPU runtime, one RESOURCE_EXHAUSTED poisons the process's
+device client permanently (every later allocation fails, even 1 MB —
+measured), so OOM recovery cannot happen in-process: the failed chunk's
+quarters must run in fresh processes with their own clients.  This module
+is that fresh process: it runs exactly one chunk via ``run_one_chunk``
+and reports the summary as one JSON line on stdout.
+
+Exit codes: 0 success (JSON on stdout; ``null`` for an empty-mask chunk),
+17 device OOM (the parent splits and retries), anything else = real error
+(propagated by the parent).
+
+Usage (emitted by ``run_one_chunk_resilient`` — not user-facing):
+    python -m kafka_tpu.cli.chunk_worker <config.json> <x0> <y0> \
+        <nx_valid> <ny_valid> <chunk_no> <prefix>
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+OOM_EXIT_CODE = 17
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    cfg_path, x0, y0, nx, ny, chunk_no, prefix = argv
+    from ..engine.config import RunConfig
+    from ..io.tiling import Chunk
+    from .drivers import (
+        _is_oom,
+        load_state_mask,
+        resolve_aux_builder,
+        run_one_chunk,
+    )
+    from ..utils.compilation_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    cfg = RunConfig.load(cfg_path)
+    chunk = Chunk(int(x0), int(y0), int(nx), int(ny), int(chunk_no))
+    full_mask, geo = load_state_mask(cfg)
+    try:
+        summary = run_one_chunk(
+            cfg, chunk, prefix, full_mask, geo,
+            resolve_aux_builder(cfg),
+        )
+    except Exception as exc:  # noqa: BLE001 — classified for the parent
+        if _is_oom(exc):
+            print(str(exc)[:500], file=sys.stderr)
+            return OOM_EXIT_CODE
+        raise
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

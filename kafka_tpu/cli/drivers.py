@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import time
 from typing import Callable, Optional
 
@@ -137,8 +138,18 @@ def run_one_chunk(
                 prefix, grid[0], len(grid) - 1,
             )
     t0 = time.time()
-    kf.run(grid, x0, None, p_inv0, checkpointer=checkpointer,
-           advance_first=advance_first)
+    try:
+        kf.run(grid, x0, None, p_inv0, checkpointer=checkpointer,
+               advance_first=advance_first)
+    except BaseException:
+        # Tear the async writer down on failure too — an abandoned worker
+        # thread (and any device arrays in its queue) would outlive the
+        # failed attempt and eat into a retry's device memory.
+        try:
+            output.close()
+        except Exception:
+            pass
+        raise
     output.close()
     return {
         "prefix": prefix,
@@ -146,6 +157,193 @@ def run_one_chunk(
         "n_dates_assimilated": len(kf.diagnostics_log),
         "wall_s": round(time.time() - t0, 3),
     }
+
+
+def _is_oom(exc: BaseException) -> bool:
+    text = str(exc)
+    return "RESOURCE_EXHAUSTED" in text or "ResourceExhausted" in text
+
+
+def split_chunk(chunk) -> list:
+    """Quarter a chunk (2x2, odd sizes rounded up in the first half)."""
+    from ..io.tiling import Chunk
+
+    hx = (chunk.nx_valid + 1) // 2
+    hy = (chunk.ny_valid + 1) // 2
+    subs = []
+    for y0, ny in ((chunk.y0, hy), (chunk.y0 + hy, chunk.ny_valid - hy)):
+        for x0, nx in ((chunk.x0, hx), (chunk.x0 + hx, chunk.nx_valid - hx)):
+            if nx > 0 and ny > 0:
+                subs.append(Chunk(x0, y0, nx, ny, chunk.chunk_no))
+    return subs
+
+
+#: aux builders reconstructible by name in a fresh worker process.
+def resolve_aux_builder(cfg: RunConfig) -> Optional[Callable]:
+    # The joint S2+S1 configuration feeds the same scene-angle builder to
+    # its Sentinel-2 side (run_joint.py).
+    if cfg.operator in ("prosail", "prosail_joint"):
+        return prosail_aux_builder
+    return None
+
+
+#: set once this process's device client has thrown RESOURCE_EXHAUSTED:
+#: after that, EVERY allocation in this process fails (measured on the
+#: tunneled TPU runtime — even 1 MB), so all further chunk work must run
+#: in fresh subprocesses.
+_DEVICE_POISONED = False
+
+
+def _run_chunk_subprocess(cfg: RunConfig, chunk, prefix: str):
+    """Run one chunk in a fresh interpreter (fresh device client).
+
+    Returns ``(exit_code, summary_or_None)``."""
+    import subprocess
+    import sys
+    import tempfile
+
+    from .chunk_worker import OOM_EXIT_CODE  # noqa: F401 (doc link)
+
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".json", delete=False
+    ) as f:
+        f.write(cfg.to_json())
+        cfg_path = f.name
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "kafka_tpu.cli.chunk_worker",
+             cfg_path, str(chunk.x0), str(chunk.y0),
+             str(chunk.nx_valid), str(chunk.ny_valid),
+             str(chunk.chunk_no), prefix],
+            capture_output=True, text=True,
+        )
+    finally:
+        os.unlink(cfg_path)
+    summary = None
+    if proc.returncode == 0:
+        parsed = False
+        for line in reversed(proc.stdout.strip().splitlines() or [""]):
+            try:
+                summary = json.loads(line)
+                parsed = True
+                break
+            except json.JSONDecodeError:
+                continue
+        if not parsed:
+            # rc 0 contractually prints one JSON line; a silent None here
+            # would undercount run stats while the outputs exist on disk.
+            LOG.error(
+                "chunk worker %s exited 0 without a summary JSON line "
+                "(stdout: %r)", prefix, proc.stdout[-300:],
+            )
+    else:
+        LOG.warning(
+            "chunk worker %s rc=%d: %s", prefix, proc.returncode,
+            proc.stderr.strip()[-500:],
+        )
+    return proc.returncode, summary
+
+
+def run_one_chunk_resilient(
+    cfg: RunConfig,
+    chunk,
+    prefix: str,
+    full_mask: np.ndarray,
+    geo,
+    aux_builder: Optional[Callable] = None,
+    operator=None,
+    max_splits: int = 2,
+) -> Optional[dict]:
+    """``run_one_chunk`` with device-OOM recovery.
+
+    A RESOURCE_EXHAUSTED poisons this process's device client permanently
+    (see ``_DEVICE_POISONED``), so recovery is process-based: after the
+    first OOM, every chunk — the failed one and all that follow — runs in
+    a fresh subprocess (``cli.chunk_worker``); a chunk whose working set
+    genuinely exceeds HBM OOMs in its own process too and is split into
+    four quarter chunks (recursively, up to ``max_splits`` levels), each
+    with a suffixed output prefix.  Chunk sizing stops being a hard
+    failure mode: the configured size is a hint, oversize chunks degrade
+    into more files instead of a crash.  Non-OOM errors propagate.
+
+    The subprocess path needs the aux builder reconstructible by name
+    (``resolve_aux_builder``); runs with a custom injected builder fail
+    loudly rather than silently dropping it.
+    """
+    global _DEVICE_POISONED
+    from .chunk_worker import OOM_EXIT_CODE
+
+    if not _DEVICE_POISONED:
+        try:
+            return run_one_chunk(
+                cfg, chunk, prefix, full_mask, geo, aux_builder,
+                operator=operator,
+            )
+        except Exception as exc:  # noqa: BLE001 — filtered to OOM below
+            if not _is_oom(exc):
+                raise
+            _DEVICE_POISONED = True
+            LOG.warning(
+                "chunk %s (%dx%d px) exhausted device memory; this "
+                "process's device client is no longer usable — running "
+                "remaining work in fresh subprocesses",
+                prefix, chunk.nx_valid, chunk.ny_valid,
+            )
+    if aux_builder is not None and \
+            aux_builder is not resolve_aux_builder(cfg):
+        raise RuntimeError(
+            "device OOM recovery needs a subprocess, but the injected "
+            "aux_builder cannot be reconstructed there; re-run with "
+            "smaller chunk_size"
+        )
+    rc, summary = _run_chunk_subprocess(cfg, chunk, prefix)
+    if rc == 0:
+        return summary
+    if rc != OOM_EXIT_CODE:
+        raise RuntimeError(
+            f"chunk worker for {prefix} failed (rc={rc})"
+        )
+    if max_splits <= 0 or min(chunk.nx_valid, chunk.ny_valid) < 2:
+        raise RuntimeError(
+            f"chunk {prefix} exceeds device memory even at "
+            f"{chunk.nx_valid}x{chunk.ny_valid} px (split limit reached)"
+        )
+    LOG.warning(
+        "chunk %s (%dx%d px) exceeds device memory; splitting 2x2",
+        prefix, chunk.nx_valid, chunk.ny_valid,
+    )
+    # The failed full-chunk attempts may have flushed partial rasters
+    # under this prefix before dying; remove them so the quarter outputs
+    # are the only files for these pixels (a downstream mosaic globbing
+    # the prefix must not double-read stale data).
+    if getattr(cfg, "output_folder", None):
+        import glob as _glob
+
+        for pattern in (f"*_{prefix}.tif", f"*_{prefix}_unc.tif"):
+            for stale in _glob.glob(
+                os.path.join(cfg.output_folder, pattern)
+            ):
+                LOG.info("removing partial output %s", stale)
+                os.unlink(stale)
+    merged = {
+        "prefix": prefix, "n_pixels": 0, "n_dates_assimilated": 0,
+        "wall_s": 0.0, "oom_split": True,
+    }
+    any_ran = False
+    for tag, sub in zip("abcd", split_chunk(chunk)):
+        s = run_one_chunk_resilient(
+            cfg, sub, prefix + tag, full_mask, geo, aux_builder,
+            operator=operator, max_splits=max_splits - 1,
+        )
+        if s is not None:
+            any_ran = True
+            merged["n_pixels"] += s.get("n_pixels", 0)
+            merged["n_dates_assimilated"] = max(
+                merged["n_dates_assimilated"],
+                s.get("n_dates_assimilated", 0),
+            )
+            merged["wall_s"] += s.get("wall_s", 0.0)
+    return merged if any_ran else None
 
 
 def run_config(
@@ -170,7 +368,7 @@ def run_config(
     operator = cfg.make_operator()
 
     def run_one(chunk, prefix):
-        s = run_one_chunk(
+        s = run_one_chunk_resilient(
             cfg, chunk, prefix, full_mask, geo, aux_builder,
             operator=operator,
         )

@@ -365,3 +365,137 @@ class TestCheckpointedDriver:
         tifs = glob.glob(os.path.join(str(tmp_path / "out"),
                                       "lai_A2017190_*.tif"))
         assert tifs, "resumed run wrote no outputs for the final window"
+
+
+class TestOomRecovery:
+    """Device-OOM recovery is process-based: one RESOURCE_EXHAUSTED
+    poisons the whole process's device client (measured on the tunneled
+    TPU runtime), so the failed chunk and everything after it run in
+    fresh subprocesses, splitting 2x2 when a chunk genuinely exceeds
+    HBM."""
+
+    @pytest.fixture(autouse=True)
+    def _fresh_poison_flag(self):
+        from kafka_tpu.cli import drivers
+
+        drivers._DEVICE_POISONED = False
+        yield
+        drivers._DEVICE_POISONED = False
+
+    def test_oom_splits_via_subprocesses(self, monkeypatch):
+        from kafka_tpu.cli import drivers
+        from kafka_tpu.cli.chunk_worker import OOM_EXIT_CODE
+        from kafka_tpu.io.tiling import Chunk
+
+        sub_calls = []
+
+        def fake_run_one_chunk(cfg, chunk, prefix, *a, **k):
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: TPU backend error (ResourceExhausted)."
+            )
+
+        def fake_subprocess(cfg, chunk, prefix):
+            sub_calls.append((prefix, chunk.nx_valid, chunk.ny_valid))
+            if chunk.nx_valid > 64 or chunk.ny_valid > 64:
+                return OOM_EXIT_CODE, None
+            return 0, {"prefix": prefix,
+                       "n_pixels": chunk.nx_valid * chunk.ny_valid,
+                       "n_dates_assimilated": 3, "wall_s": 0.5}
+
+        monkeypatch.setattr(drivers, "run_one_chunk", fake_run_one_chunk)
+        monkeypatch.setattr(
+            drivers, "_run_chunk_subprocess", fake_subprocess
+        )
+
+        import tempfile
+
+        outdir = tempfile.mkdtemp()
+        stale = os.path.join(outdir, "lai_A2017183_0001.tif")
+        keep = os.path.join(outdir, "lai_A2017183_0001a.tif")
+        open(stale, "w").close()
+        open(keep, "w").close()
+
+        class Cfg:
+            output_folder = outdir
+
+        chunk = Chunk(0, 0, 128, 100, 1)
+        s = drivers.run_one_chunk_resilient(
+            Cfg(), chunk, "0001", None, None
+        )
+        # partial full-prefix outputs removed before the split; quarter
+        # outputs untouched
+        assert not os.path.exists(stale)
+        assert os.path.exists(keep)
+        # full chunk retried in a fresh process first, then 4 quarters
+        assert sub_calls[0] == ("0001", 128, 100)
+        assert sorted(c[0] for c in sub_calls[1:]) == [
+            "0001a", "0001b", "0001c", "0001d"
+        ]
+        assert all(c[1] <= 64 and c[2] <= 64 for c in sub_calls[1:])
+        assert s["oom_split"] and s["n_pixels"] == 128 * 100
+        assert s["n_dates_assimilated"] == 3
+        assert drivers._DEVICE_POISONED
+
+    def test_poisoned_process_skips_in_process_path(self, monkeypatch):
+        from kafka_tpu.cli import drivers
+        from kafka_tpu.io.tiling import Chunk
+
+        def boom(*a, **k):
+            raise AssertionError("in-process path used after poisoning")
+
+        monkeypatch.setattr(drivers, "run_one_chunk", boom)
+        monkeypatch.setattr(
+            drivers, "_run_chunk_subprocess",
+            lambda cfg, chunk, prefix: (0, {"prefix": prefix,
+                                            "n_pixels": 1}),
+        )
+        drivers._DEVICE_POISONED = True
+        s = drivers.run_one_chunk_resilient(
+            None, Chunk(0, 0, 32, 32, 1), "0002", None, None
+        )
+        assert s == {"prefix": "0002", "n_pixels": 1}
+
+    def test_non_oom_errors_propagate(self, monkeypatch):
+        from kafka_tpu.cli import drivers
+        from kafka_tpu.io.tiling import Chunk
+
+        def fake_run_one_chunk(*a, **k):
+            raise ValueError("broken reader")
+
+        monkeypatch.setattr(drivers, "run_one_chunk", fake_run_one_chunk)
+        with pytest.raises(ValueError, match="broken reader"):
+            drivers.run_one_chunk_resilient(
+                None, Chunk(0, 0, 32, 32, 1), "0001", None, None
+            )
+
+    def test_chunk_worker_subprocess_end_to_end(self, tmp_path):
+        """The real worker entry point: serialise a config, run one chunk
+        in a child interpreter (CPU backend via the test env), read the
+        summary JSON back, and find its GeoTIFF outputs on disk."""
+        import datetime as dt
+
+        from kafka_tpu.cli import drivers
+        from kafka_tpu.engine.config import RunConfig
+        from kafka_tpu.engine.priors import PROSAIL_PARAMETER_LIST
+        from kafka_tpu.io.tiling import Chunk
+
+        dates = [dt.datetime(2017, 7, 1), dt.datetime(2017, 7, 3)]
+        make_s2_granule_tree(str(tmp_path / "s2"), dates, ny=48, nx=64)
+        write_mask(str(tmp_path / "mask.tif"), 48, 64)
+        cfg = RunConfig(
+            parameter_list=PROSAIL_PARAMETER_LIST,
+            start=dt.datetime(2017, 6, 30), end=dt.datetime(2017, 7, 4),
+            step_days=2, operator="prosail", propagator="none",
+            prior="sail", chunk_size=(64, 64), observations="sentinel2",
+            data_folder=str(tmp_path / "s2"),
+            state_mask=str(tmp_path / "mask.tif"),
+            output_folder=str(tmp_path / "out"),
+            solver_options={"relaxation": 0.7},
+        )
+        rc, summary = drivers._run_chunk_subprocess(
+            cfg, Chunk(0, 0, 64, 48, 1), "0001"
+        )
+        assert rc == 0, summary
+        assert summary["n_pixels"] > 0
+        tifs = glob.glob(str(tmp_path / "out" / "*_0001*.tif"))
+        assert tifs, "worker wrote no outputs"

@@ -105,3 +105,56 @@ def test_scheduler_round_robin_and_restart(tmp_path):
     assert stats["run"] == 0 and stats["skipped"] == 4
     assert len(ran) == 16
     assert pending_chunks(assign_chunks(chunks, 4), outdir, 2) == []
+
+
+def test_fused_scan_composes_with_sharding(eight_cpu_devices):
+    """Temporal fusion under GSPMD: assimilate_windows_scan on arrays
+    sharded over the pixel mesh must run multi-device and agree with the
+    single-device fused program (fusion x sharding composition)."""
+    from kafka_tpu.core.solvers import assimilate_windows_scan
+    from kafka_tpu.core.types import BandBatch
+    from kafka_tpu.shard import pixel_sharding, replicated
+
+    mesh = make_pixel_mesh(eight_cpu_devices)
+    n_pix = pad_for_mesh(200, mesh, lane=8)
+    op, b1, x0, pi0 = _problem(n_pix, seed=0)
+    _, b2, _, _ = _problem(n_pix, seed=1)
+    m = jnp.eye(7, dtype=jnp.float32)
+    q = jnp.full((7,), 0.01, jnp.float32)
+    opts = {"state_bounds": (
+        jnp.asarray(op.state_bounds[0]), jnp.asarray(op.state_bounds[1])
+    )}
+    stacked = BandBatch(
+        y=jnp.stack([b1.y, b2.y]),
+        r_inv=jnp.stack([b1.r_inv, b2.r_inv]),
+        mask=jnp.stack([b1.mask, b2.mask]),
+    )
+
+    # single device
+    _, _, xs_ref, diag_ref, iters_ref, _ = assimilate_windows_scan(
+        op.linearize, stacked, x0, pi0, None, m, q, None, None,
+        propagate_information_filter, dict(opts), None,
+    )
+
+    # sharded: pixel axis is axis 2 of the stacked bands (K, B, n)
+    band_sh = pixel_sharding(mesh, batch_axis=2, ndim=3)
+    stacked_sh = BandBatch(
+        y=jax.device_put(stacked.y, band_sh),
+        r_inv=jax.device_put(stacked.r_inv, band_sh),
+        mask=jax.device_put(stacked.mask, band_sh),
+    )
+    xs0, ps0 = shard_state(mesh, x0, pi0)
+    x_fin, p_fin, xs_sh, diag_sh, iters_sh, _ = assimilate_windows_scan(
+        op.linearize, stacked_sh, xs0, ps0, None, m, q, None, None,
+        propagate_information_filter, dict(opts), None,
+    )
+    assert len(x_fin.sharding.device_set) == len(eight_cpu_devices)
+    np.testing.assert_array_equal(
+        np.asarray(iters_sh), np.asarray(iters_ref)
+    )
+    np.testing.assert_allclose(
+        np.asarray(xs_sh), np.asarray(xs_ref), atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(diag_sh), np.asarray(diag_ref), rtol=5e-3, atol=5e-2
+    )
