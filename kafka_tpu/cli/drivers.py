@@ -187,6 +187,20 @@ def resolve_aux_builder(cfg: RunConfig) -> Optional[Callable]:
     return None
 
 
+def _remove_outputs(cfg, patterns) -> None:
+    """Delete output rasters matching ``patterns`` in the run's output
+    folder — the split/success paths use this to guarantee that exactly
+    one generation of files covers any pixel."""
+    if not getattr(cfg, "output_folder", None):
+        return
+    import glob as _glob
+
+    for pattern in patterns:
+        for stale in _glob.glob(os.path.join(cfg.output_folder, pattern)):
+            LOG.info("removing stale output %s", stale)
+            os.unlink(stale)
+
+
 #: set once this process's device client has thrown RESOURCE_EXHAUSTED:
 #: after that, EVERY allocation in this process fails (measured on the
 #: tunneled TPU runtime — even 1 MB), so all further chunk work must run
@@ -209,14 +223,30 @@ def _run_chunk_subprocess(cfg: RunConfig, chunk, prefix: str):
     ) as f:
         f.write(cfg.to_json())
         cfg_path = f.name
+    # Generous hang guard: a wedged device client (a known failure mode
+    # of this runtime after OOM) must surface as a failed worker, not
+    # block the scheduler forever.  Far above any measured chunk time
+    # (largest observed: ~7 min for an annual 1.2M-px chunk);
+    # overridable per run via extra["chunk_worker_timeout"].
+    timeout_s = float(
+        (getattr(cfg, "extra", None) or {}).get(
+            "chunk_worker_timeout", 4 * 3600
+        )
+    )
     try:
         proc = subprocess.run(
             [sys.executable, "-m", "kafka_tpu.cli.chunk_worker",
              cfg_path, str(chunk.x0), str(chunk.y0),
              str(chunk.nx_valid), str(chunk.ny_valid),
              str(chunk.chunk_no), prefix],
-            capture_output=True, text=True,
+            capture_output=True, text=True, timeout=timeout_s,
         )
+    except subprocess.TimeoutExpired:
+        LOG.error(
+            "chunk worker %s exceeded %.0f s and was killed",
+            prefix, timeout_s,
+        )
+        return 124, None
     finally:
         os.unlink(cfg_path)
     summary = None
@@ -275,10 +305,12 @@ def run_one_chunk_resilient(
 
     if not _DEVICE_POISONED:
         try:
-            return run_one_chunk(
+            result = run_one_chunk(
                 cfg, chunk, prefix, full_mask, geo, aux_builder,
                 operator=operator,
             )
+            _remove_outputs(cfg, [f"*_{prefix}[abcd]*.tif"])
+            return result
         except Exception as exc:  # noqa: BLE001 — filtered to OOM below
             if not _is_oom(exc):
                 raise
@@ -298,6 +330,10 @@ def run_one_chunk_resilient(
         )
     rc, summary = _run_chunk_subprocess(cfg, chunk, prefix)
     if rc == 0:
+        # Symmetric to the pre-split cleanup: a full-chunk success must
+        # remove quarter outputs left by an earlier crashed split of the
+        # same chunk, or mosaics double-read those pixels.
+        _remove_outputs(cfg, [f"*_{prefix}[abcd]*.tif"])
         return summary
     if rc != OOM_EXIT_CODE:
         raise RuntimeError(
@@ -316,15 +352,7 @@ def run_one_chunk_resilient(
     # under this prefix before dying; remove them so the quarter outputs
     # are the only files for these pixels (a downstream mosaic globbing
     # the prefix must not double-read stale data).
-    if getattr(cfg, "output_folder", None):
-        import glob as _glob
-
-        for pattern in (f"*_{prefix}.tif", f"*_{prefix}_unc.tif"):
-            for stale in _glob.glob(
-                os.path.join(cfg.output_folder, pattern)
-            ):
-                LOG.info("removing partial output %s", stale)
-                os.unlink(stale)
+    _remove_outputs(cfg, [f"*_{prefix}.tif", f"*_{prefix}_unc.tif"])
     merged = {
         "prefix": prefix, "n_pixels": 0, "n_dates_assimilated": 0,
         "wall_s": 0.0, "oom_split": True,

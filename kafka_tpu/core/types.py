@@ -97,6 +97,9 @@ class SolveDiagnostics(NamedTuple):
     fwd_modelled: jnp.ndarray
     n_iterations: jnp.ndarray
     convergence_norm: jnp.ndarray
+    #: (n_pix,) bool — which pixels froze at a converged fixed point;
+    #: only populated by ``per_pixel_convergence`` solves (else None).
+    converged_mask: Any = None
 
 
 def flat_to_pixel_major(x_flat: jnp.ndarray, n_params: int) -> jnp.ndarray:

@@ -437,3 +437,113 @@ class TestPallasSolve:
                 solve_spd_packed_pallas(a_packed, b, interpret=True)
             )
             np.testing.assert_allclose(x_pl, x_ref, rtol=2e-5, atol=2e-5)
+
+
+class TestPerPixelConvergence:
+    """solver option per_pixel_convergence (SURVEY §7(c)): converged
+    pixels freeze at their fixed point instead of riding a global norm."""
+
+    def _solve(self, n, per_pixel, sigma=0.03, relaxation=1.0, seed=0):
+        import jax.numpy as jnp
+
+        from kafka_tpu.core.solvers import assimilate_date_jit
+        from kafka_tpu.testing.synthetic import make_tip_problem
+
+        op, bands, x0, p_inv0 = make_tip_problem(n, seed=seed, sigma=sigma)
+        opts = {
+            "state_bounds": (
+                jnp.asarray(op.state_bounds[0]),
+                jnp.asarray(op.state_bounds[1]),
+            ),
+            "relaxation": relaxation,
+            "per_pixel_convergence": per_pixel,
+        }
+        x, p_inv, diags = assimilate_date_jit(
+            op.linearize, bands, x0, p_inv0, None, opts
+        )
+        return op, bands, x0, p_inv0, np.asarray(x), diags
+
+    def test_converged_mask_pixels_are_fixed_points(self):
+        """Every pixel the solver reports frozen must be a Gauss-Newton
+        fixed point of the ORIGINAL problem (prior still anchored at the
+        forecast): one more true GN step moves it less than tol."""
+        import jax.numpy as jnp
+
+        from kafka_tpu.core.solvers import kalman_update
+
+        op, bands, x0, p_inv0, x, diags = self._solve(
+            256, True, relaxation=0.7
+        )
+        frozen = np.asarray(diags.converged_mask)
+        assert frozen.any(), "no pixel converged; test inconclusive"
+        lin = op.linearize(None, jnp.asarray(x))
+        x_new, _ = kalman_update(lin, bands, jnp.asarray(x),
+                                 jnp.asarray(x0), p_inv0)
+        x_new = jnp.asarray(x) + 0.7 * (x_new - jnp.asarray(x))
+        x_new = jnp.clip(x_new, jnp.asarray(op.state_bounds[0]),
+                         jnp.asarray(op.state_bounds[1]))
+        step = np.sqrt(((np.asarray(x_new) - x) ** 2).sum(axis=-1)) / 7
+        assert (step[frozen] < 2e-3).all(), step[frozen].max()
+
+    def test_frozen_pixels_invariant_to_extra_iterations(self):
+        """Once frozen, a pixel must not move however long the loop keeps
+        running for its stiff neighbours."""
+        import jax.numpy as jnp
+
+        from kafka_tpu.core.solvers import iterated_solve
+        from kafka_tpu.testing.synthetic import make_tip_problem
+
+        op, bands, x0, p_inv0 = make_tip_problem(256, sigma=0.03)
+        common = dict(
+            relaxation=0.7, per_pixel_convergence=True,
+            state_bounds=(jnp.asarray(op.state_bounds[0]),
+                          jnp.asarray(op.state_bounds[1])),
+        )
+        x_a, _, d_a = iterated_solve(
+            op.linearize, bands, x0, p_inv0, None,
+            max_iterations=10, **common
+        )
+        x_b, _, d_b = iterated_solve(
+            op.linearize, bands, x0, p_inv0, None,
+            max_iterations=25, **common
+        )
+        frozen_a = np.asarray(d_a.converged_mask)
+        assert frozen_a.any()
+        np.testing.assert_array_equal(
+            np.asarray(x_a)[frozen_a], np.asarray(x_b)[frozen_a]
+        )
+
+    def test_global_mode_reports_no_mask(self):
+        _, _, _, _, _, diags = self._solve(64, False)
+        assert diags.converged_mask is None
+
+    def test_stricter_than_global_norm(self):
+        """The per-pixel criterion is strictly per pixel: the weak global
+        norm (normalised by n*p, linear_kf.py:296) can declare a batch
+        converged while individual pixels still move; per-pixel mode
+        keeps iterating exactly those."""
+        _, _, _, _, _, d_gl = self._solve(128, False, relaxation=0.7)
+        _, _, _, _, _, d_pp = self._solve(128, True, relaxation=0.7)
+        assert int(d_pp.n_iterations) >= int(d_gl.n_iterations)
+
+    def test_all_masked_returns_forecast(self):
+        import jax.numpy as jnp
+
+        from kafka_tpu.core.solvers import assimilate_date_jit
+        from kafka_tpu.core.types import BandBatch
+        from kafka_tpu.testing.synthetic import make_tip_problem
+
+        op, bands, x0, p_inv0 = make_tip_problem(64)
+        zb = BandBatch(
+            y=jnp.zeros_like(bands.y),
+            r_inv=jnp.zeros_like(bands.r_inv),
+            mask=jnp.zeros_like(bands.mask),
+        )
+        x, p_inv, _ = assimilate_date_jit(
+            op.linearize, zb, x0, p_inv0, None,
+            {"per_pixel_convergence": True},
+        )
+        np.testing.assert_allclose(np.asarray(x), np.asarray(x0),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(p_inv),
+                                   np.asarray(p_inv0), atol=1e-4)
