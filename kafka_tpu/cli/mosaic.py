@@ -1,0 +1,143 @@
+"""Mosaic per-chunk outputs into single-tile rasters.
+
+A chunked/distributed run (and the OOM splitter) writes one GeoTIFF per
+parameter per timestep PER CHUNK PREFIX — the reference leaves its users
+with exactly the same pile of prefixed files (``hex(chunk)`` prefixes,
+``/root/reference/kafka_test_Py36.py:164-166``) and no tool.  This one
+stitches them: chunk placement comes from each file's own geotransform
+relative to the mosaic grid, so quarters from an OOM split and whole
+chunks compose identically.
+
+Usage:
+    python -m kafka_tpu.cli.mosaic <folder> [--param lai ...]
+        [--date A2017183 ...] [--include-unc] [--outdir <folder>]
+
+Without ``--param``/``--date`` every parameter and timestep discovered in
+the folder is mosaicked.  Output naming: ``{param}_{date}[_unc].tif`` in
+``--outdir`` (default ``<folder>/mosaic``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import logging
+import os
+import re
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..io.geotiff import GeoInfo, read_geotiff, read_info, write_geotiff
+
+LOG = logging.getLogger(__name__)
+
+#: ``{param}_{A%Y%j}_{prefix}[_unc].tif`` — prefix is the chunk id with
+#: optional a-d quarter suffixes from OOM splits.
+_NAME = re.compile(
+    r"^(?P<param>.+)_(?P<date>A\d{7})_(?P<prefix>[0-9a-fx]+)"
+    r"(?P<unc>_unc)?\.tif$"
+)
+
+
+def discover(folder: str) -> Dict[Tuple[str, str, bool], List[str]]:
+    """Group chunk files by (param, date, is_unc)."""
+    groups: Dict[Tuple[str, str, bool], List[str]] = defaultdict(list)
+    for path in sorted(glob.glob(os.path.join(folder, "*.tif"))):
+        m = _NAME.match(os.path.basename(path))
+        if m:
+            groups[(
+                m.group("param"), m.group("date"), bool(m.group("unc"))
+            )].append(path)
+    return dict(groups)
+
+
+def mosaic_files(files: List[str], out_path: str) -> Tuple[int, int]:
+    """Stitch chunk rasters into one grid by their geotransforms.
+
+    All inputs must share resolution and CRS (they come from one run).
+    Returns the mosaic (height, width)."""
+    infos = [read_info(f) for f in files]
+    gts = [i.geo.geotransform for i in infos]
+    rx, ry = gts[0][1], gts[0][5]
+    crs0 = (infos[0].geo.epsg, infos[0].geo.projection)
+    for f, info, gt in zip(files, infos, gts):
+        if (gt[1], gt[5]) != (rx, ry):
+            raise ValueError(
+                f"{f}: resolution {(gt[1], gt[5])} != {(rx, ry)}"
+            )
+        if (info.geo.epsg, info.geo.projection) != crs0:
+            raise ValueError(
+                f"{f}: CRS {(info.geo.epsg, info.geo.projection)} != "
+                f"{crs0} — mixed-projection chunks cannot share a grid"
+            )
+    x0 = min(gt[0] for gt in gts)
+    y0 = max(gt[3] for gt in gts) if ry < 0 else min(gt[3] for gt in gts)
+    cols = [int(round((gt[0] - x0) / rx)) for gt in gts]
+    rows = [int(round((gt[3] - y0) / ry)) for gt in gts]
+    width = max(c + i.width for c, i in zip(cols, infos))
+    height = max(r + i.height for r, i in zip(rows, infos))
+    out = np.zeros((height, width), np.float32)
+    for path, info, r, c in zip(files, infos, rows, cols):
+        arr, _ = read_geotiff(path)
+        out[r:r + info.height, c:c + info.width] = arr
+    # Coverage check: the chunk extents must tile the bounding box — a
+    # missing chunk (unfinished process, half-written OOM split) would
+    # otherwise yield a silently gap-filled product.
+    covered = sum(i.width * i.height for i in infos)
+    if covered != width * height:
+        LOG.warning(
+            "%s: chunk files cover %d of %d px (%s) — missing or "
+            "overlapping chunks; uncovered pixels are zero",
+            out_path, covered, width * height,
+            "under" if covered < width * height else "over",
+        )
+    geo = GeoInfo(
+        geotransform=(x0, rx, gts[0][2], y0, gts[0][4], ry),
+        projection=infos[0].geo.projection,
+        epsg=infos[0].geo.epsg,
+    )
+    write_geotiff(out_path, out, geo)
+    return height, width
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("folder")
+    ap.add_argument("--param", action="append", default=None)
+    ap.add_argument("--date", action="append", default=None)
+    ap.add_argument("--include-unc", action="store_true")
+    ap.add_argument("--outdir", default=None)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING
+    )
+    outdir = args.outdir or os.path.join(args.folder, "mosaic")
+    os.makedirs(outdir, exist_ok=True)
+
+    groups = discover(args.folder)
+    if not groups:
+        raise SystemExit(f"no chunk outputs found in {args.folder}")
+    written = []
+    for (param, date, unc), files in sorted(groups.items()):
+        if args.param and param not in args.param:
+            continue
+        if args.date and date not in args.date:
+            continue
+        if unc and not args.include_unc:
+            continue
+        name = f"{param}_{date}{'_unc' if unc else ''}.tif"
+        out_path = os.path.join(outdir, name)
+        h, w = mosaic_files(files, out_path)
+        LOG.info("%s: %d chunks -> %dx%d", name, len(files), h, w)
+        written.append({"file": name, "chunks": len(files),
+                        "shape": [h, w]})
+    print(json.dumps({"outdir": outdir, "mosaics": written}))
+    return written
+
+
+if __name__ == "__main__":
+    main()

@@ -499,3 +499,60 @@ class TestOomRecovery:
         assert summary["n_pixels"] > 0
         tifs = glob.glob(str(tmp_path / "out" / "*_0001*.tif"))
         assert tifs, "worker wrote no outputs"
+
+
+class TestMosaic:
+    def test_mosaic_reassembles_chunked_run(self, tmp_path):
+        """A 2x2-chunked synthetic S2 run mosaicked back together must
+        equal the same run executed as ONE chunk, pixel for pixel."""
+        import datetime as dt
+
+        from kafka_tpu.cli.drivers import prosail_aux_builder, run_config
+        from kafka_tpu.cli.mosaic import main as mosaic_main
+        from kafka_tpu.engine.config import RunConfig
+        from kafka_tpu.engine.priors import PROSAIL_PARAMETER_LIST
+
+        dates = [dt.datetime(2017, 7, 1), dt.datetime(2017, 7, 3)]
+        make_s2_granule_tree(str(tmp_path / "s2"), dates, ny=64, nx=96)
+        write_mask(str(tmp_path / "mask.tif"), 64, 96)
+
+        def cfg(chunks, outdir):
+            return RunConfig(
+                parameter_list=PROSAIL_PARAMETER_LIST,
+                start=dt.datetime(2017, 6, 30),
+                end=dt.datetime(2017, 7, 4),
+                step_days=2, operator="prosail", propagator="none",
+                prior="sail", chunk_size=chunks,
+                observations="sentinel2",
+                data_folder=str(tmp_path / "s2"),
+                state_mask=str(tmp_path / "mask.tif"),
+                output_folder=str(tmp_path / outdir),
+                solver_options={"relaxation": 0.7},
+            )
+
+        run_config(cfg((48, 32), "chunked"),
+                   aux_builder=prosail_aux_builder)
+        run_config(cfg((96, 64), "whole"),
+                   aux_builder=prosail_aux_builder)
+
+        written = mosaic_main([
+            str(tmp_path / "chunked"), "--param", "lai",
+            "--include-unc",
+        ])
+        assert written, "no mosaics written"
+        whole_files = sorted(
+            glob.glob(str(tmp_path / "whole" / "lai_*.tif"))
+        )
+        assert whole_files
+        for wf in whole_files:
+            base = os.path.basename(wf)
+            # whole-run name lai_A2017183_0001[_unc].tif ->
+            # mosaic lai_A2017183[_unc].tif
+            mos_name = base.replace("_0001", "")
+            mos = str(tmp_path / "chunked" / "mosaic" / mos_name)
+            assert os.path.exists(mos), mos_name
+            a, ia = read_geotiff(wf)
+            b, ib = read_geotiff(mos)
+            assert a.shape == b.shape
+            assert ia.geo.geotransform == ib.geo.geotransform
+            np.testing.assert_allclose(b, a, rtol=1e-2, atol=2e-3)
