@@ -54,10 +54,20 @@ def discover(folder: str) -> Dict[Tuple[str, str, bool], List[str]]:
     return dict(groups)
 
 
-def mosaic_files(files: List[str], out_path: str) -> Tuple[int, int]:
+def mosaic_files(files: List[str], out_path: str,
+                 like: str = None) -> Tuple[int, int]:
     """Stitch chunk rasters into one grid by their geotransforms.
 
     All inputs must share resolution and CRS (they come from one run).
+
+    ``like`` — optional raster (typically the run's state mask) whose
+    grid becomes the mosaic grid.  Without it the extent is the bounding
+    box of the files present, which SHRINKS when edge chunks had empty
+    masks and wrote nothing; with it the product always aligns with the
+    full tile, and the coverage check becomes exact: a warning fires
+    only where the like-raster has VALID (non-zero) pixels that no chunk
+    file covers — genuinely missing data, not benign empty chunks.
+
     Returns the mosaic (height, width)."""
     infos = [read_info(f) for f in files]
     gts = [i.geo.geotransform for i in infos]
@@ -73,26 +83,55 @@ def mosaic_files(files: List[str], out_path: str) -> Tuple[int, int]:
                 f"{f}: CRS {(info.geo.epsg, info.geo.projection)} != "
                 f"{crs0} — mixed-projection chunks cannot share a grid"
             )
-    x0 = min(gt[0] for gt in gts)
-    y0 = max(gt[3] for gt in gts) if ry < 0 else min(gt[3] for gt in gts)
+    like_arr = None
+    if like is not None:
+        like_arr, like_info = read_geotiff(like)
+        lgt = like_info.geo.geotransform
+        if (lgt[1], lgt[5]) != (rx, ry):
+            raise ValueError(
+                f"--like {like}: resolution {(lgt[1], lgt[5])} != "
+                f"chunk resolution {(rx, ry)}"
+            )
+        x0, y0 = lgt[0], lgt[3]
+        width, height = like_info.width, like_info.height
+    else:
+        x0 = min(gt[0] for gt in gts)
+        y0 = (max(gt[3] for gt in gts) if ry < 0
+              else min(gt[3] for gt in gts))
+        width = height = None
     cols = [int(round((gt[0] - x0) / rx)) for gt in gts]
     rows = [int(round((gt[3] - y0) / ry)) for gt in gts]
-    width = max(c + i.width for c, i in zip(cols, infos))
-    height = max(r + i.height for r, i in zip(rows, infos))
+    if width is None:
+        width = max(c + i.width for c, i in zip(cols, infos))
+        height = max(r + i.height for r, i in zip(rows, infos))
     out = np.zeros((height, width), np.float32)
+    covered = np.zeros((height, width), bool)
     for path, info, r, c in zip(files, infos, rows, cols):
+        if r < 0 or c < 0 or r + info.height > height \
+                or c + info.width > width:
+            raise ValueError(
+                f"{path} lies outside the mosaic grid "
+                f"(offset {r},{c}, size {info.height}x{info.width} in "
+                f"{height}x{width})"
+            )
         arr, _ = read_geotiff(path)
         out[r:r + info.height, c:c + info.width] = arr
-    # Coverage check: the chunk extents must tile the bounding box — a
-    # missing chunk (unfinished process, half-written OOM split) would
-    # otherwise yield a silently gap-filled product.
-    covered = sum(i.width * i.height for i in infos)
-    if covered != width * height:
-        LOG.warning(
-            "%s: chunk files cover %d of %d px (%s) — missing or "
-            "overlapping chunks; uncovered pixels are zero",
-            out_path, covered, width * height,
-            "under" if covered < width * height else "over",
+        covered[r:r + info.height, c:c + info.width] = True
+    if like_arr is not None:
+        missing = int(((like_arr != 0) & ~covered).sum())
+        if missing:
+            LOG.warning(
+                "%s: %d valid pixels of %s are covered by no chunk file "
+                "— missing or half-written chunks; those pixels are zero",
+                out_path, missing, like,
+            )
+    elif not covered.all():
+        # Without an authoritative grid this is only a hint: chunks whose
+        # state mask was empty legitimately wrote no file.
+        LOG.info(
+            "%s: chunk files cover %d of %d px (empty-mask chunks are a "
+            "benign cause; pass --like <state_mask> for an exact check)",
+            out_path, int(covered.sum()), height * width,
         )
     geo = GeoInfo(
         geotransform=(x0, rx, gts[0][2], y0, gts[0][4], ry),
@@ -109,6 +148,10 @@ def main(argv=None):
     ap.add_argument("--param", action="append", default=None)
     ap.add_argument("--date", action="append", default=None)
     ap.add_argument("--include-unc", action="store_true")
+    ap.add_argument("--like", default=None,
+                    help="raster (e.g. the state mask) defining the "
+                         "mosaic grid and enabling an exact coverage "
+                         "check")
     ap.add_argument("--outdir", default=None)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
@@ -131,12 +174,19 @@ def main(argv=None):
             continue
         name = f"{param}_{date}{'_unc' if unc else ''}.tif"
         out_path = os.path.join(outdir, name)
-        h, w = mosaic_files(files, out_path)
+        h, w = mosaic_files(files, out_path, like=args.like)
         LOG.info("%s: %d chunks -> %dx%d", name, len(files), h, w)
         written.append({"file": name, "chunks": len(files),
                         "shape": [h, w]})
     print(json.dumps({"outdir": outdir, "mosaics": written}))
     return written
+
+
+def console():
+    """Console-script entry point: main returns a result object for
+    programmatic callers; sys.exit must see 0 on success."""
+    main()
+    return 0
 
 
 if __name__ == "__main__":

@@ -184,5 +184,12 @@ def main(argv=None):
     return summary
 
 
+def console():
+    """Console-script entry point: main returns a result object for
+    programmatic callers; sys.exit must see 0 on success."""
+    main()
+    return 0
+
+
 if __name__ == "__main__":
     main()

@@ -92,12 +92,17 @@ def make_sharded_step(
         _step,
         in_shardings=(band_sh, px1, px2, rep, rep, px1, px2, None),
         # Diagnostics: innovations/fwd are band-major pixel arrays, the two
-        # loop scalars are replicated.
+        # loop scalars are replicated; the per-pixel converged mask (only
+        # present under that convergence mode) rides the pixel axis.
         out_shardings=(
             px1, px2,
             SolveDiagnostics(
                 innovations=bnd, fwd_modelled=bnd,
                 n_iterations=rep, convergence_norm=rep,
+                converged_mask=(
+                    pixel_sharding(mesh, 0, 1)
+                    if opts.get("per_pixel_convergence") else None
+                ),
             ),
         ),
     )

@@ -537,7 +537,7 @@ class TestMosaic:
 
         written = mosaic_main([
             str(tmp_path / "chunked"), "--param", "lai",
-            "--include-unc",
+            "--include-unc", "--like", str(tmp_path / "mask.tif"),
         ])
         assert written, "no mosaics written"
         whole_files = sorted(

@@ -158,3 +158,34 @@ def test_fused_scan_composes_with_sharding(eight_cpu_devices):
     np.testing.assert_allclose(
         np.asarray(diag_sh), np.asarray(diag_ref), rtol=5e-3, atol=5e-2
     )
+
+
+def test_sharded_step_per_pixel_convergence(eight_cpu_devices):
+    """per_pixel_convergence under GSPMD: the converged mask comes back
+    sharded over the pixel axis and pixels behave as on one device."""
+    mesh = make_pixel_mesh(eight_cpu_devices)
+    n_pix = pad_for_mesh(200, mesh, lane=8)
+    op, bands, x0, p_inv0 = _problem(n_pix)
+    m = jnp.eye(7, dtype=jnp.float32)
+    q = jnp.full((7,), 0.01, jnp.float32)
+    opts = {
+        "state_bounds": (
+            jnp.asarray(op.state_bounds[0]),
+            jnp.asarray(op.state_bounds[1]),
+        ),
+        "relaxation": 0.7,
+        "per_pixel_convergence": True,
+    }
+    step = make_sharded_step(
+        op.linearize, mesh,
+        state_propagator=propagate_information_filter,
+        use_prior=False, solver_options=opts, n_valid=n_pix,
+    )
+    xs, ps = shard_state(mesh, x0, p_inv0)
+    bs = shard_bands(mesh, bands)
+    x_a, p_inv_a, diags = step(bs, xs, ps, m, q, xs, ps, None)
+    frozen = np.asarray(diags.converged_mask)
+    assert frozen.shape == (n_pix,) and frozen.any()
+    assert len(diags.converged_mask.sharding.device_set) == \
+        len(eight_cpu_devices)
+    assert np.isfinite(np.asarray(x_a)).all()
