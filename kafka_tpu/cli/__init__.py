@@ -1,0 +1,15 @@
+"""CLI drivers and tools."""
+
+from __future__ import annotations
+
+
+def make_console(main_fn):
+    """Wrap a driver ``main`` (which returns a result object for
+    programmatic callers) into a console-script entry point whose return
+    value ``sys.exit`` treats as success."""
+
+    def console():
+        main_fn()
+        return 0
+
+    return console

@@ -31,6 +31,7 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 from ..io.geotiff import GeoInfo, read_geotiff, read_info, write_geotiff
+from . import make_console
 
 LOG = logging.getLogger(__name__)
 
@@ -55,7 +56,7 @@ def discover(folder: str) -> Dict[Tuple[str, str, bool], List[str]]:
 
 
 def mosaic_files(files: List[str], out_path: str,
-                 like: str = None) -> Tuple[int, int]:
+                 like=None) -> Tuple[int, int]:
     """Stitch chunk rasters into one grid by their geotransforms.
 
     All inputs must share resolution and CRS (they come from one run).
@@ -72,25 +73,42 @@ def mosaic_files(files: List[str], out_path: str,
     infos = [read_info(f) for f in files]
     gts = [i.geo.geotransform for i in infos]
     rx, ry = gts[0][1], gts[0][5]
-    crs0 = (infos[0].geo.epsg, infos[0].geo.projection)
+
+    def crs_key(geo: GeoInfo):
+        # EPSG is authoritative when present; projection-name strings
+        # are a fallback (files from one run may carry one or the other).
+        return geo.epsg if geo.epsg else geo.projection
+
+    crs0 = crs_key(infos[0].geo)
     for f, info, gt in zip(files, infos, gts):
         if (gt[1], gt[5]) != (rx, ry):
             raise ValueError(
                 f"{f}: resolution {(gt[1], gt[5])} != {(rx, ry)}"
             )
-        if (info.geo.epsg, info.geo.projection) != crs0:
+        if crs_key(info.geo) != crs0:
             raise ValueError(
-                f"{f}: CRS {(info.geo.epsg, info.geo.projection)} != "
-                f"{crs0} — mixed-projection chunks cannot share a grid"
+                f"{f}: CRS {crs_key(info.geo)!r} != {crs0!r} — "
+                "mixed-projection chunks cannot share a grid"
             )
     like_arr = None
     if like is not None:
-        like_arr, like_info = read_geotiff(like)
+        # ``like`` may be a path or a preloaded (array, TiffInfo) pair
+        # (main() reads the raster once for all output groups).
+        if isinstance(like, str):
+            like_arr, like_info = read_geotiff(like)
+        else:
+            like_arr, like_info = like
         lgt = like_info.geo.geotransform
         if (lgt[1], lgt[5]) != (rx, ry):
             raise ValueError(
-                f"--like {like}: resolution {(lgt[1], lgt[5])} != "
+                f"--like: resolution {(lgt[1], lgt[5])} != "
                 f"chunk resolution {(rx, ry)}"
+            )
+        if crs_key(like_info.geo) != crs0:
+            raise ValueError(
+                f"--like: CRS {crs_key(like_info.geo)!r} != chunk CRS "
+                f"{crs0!r} — offsets computed across projections would "
+                "be meaningless"
             )
         x0, y0 = lgt[0], lgt[3]
         width, height = like_info.width, like_info.height
@@ -106,6 +124,7 @@ def mosaic_files(files: List[str], out_path: str,
         height = max(r + i.height for r, i in zip(rows, infos))
     out = np.zeros((height, width), np.float32)
     covered = np.zeros((height, width), bool)
+    overlap_px = 0
     for path, info, r, c in zip(files, infos, rows, cols):
         if r < 0 or c < 0 or r + info.height > height \
                 or c + info.width > width:
@@ -115,15 +134,28 @@ def mosaic_files(files: List[str], out_path: str,
                 f"{height}x{width})"
             )
         arr, _ = read_geotiff(path)
+        region = covered[r:r + info.height, c:c + info.width]
+        overlap_px += int(region.sum())
         out[r:r + info.height, c:c + info.width] = arr
-        covered[r:r + info.height, c:c + info.width] = True
+        region[...] = True
+    if overlap_px:
+        # Duplicate coverage means conflicting generations of files for
+        # the same pixels (e.g. a stale whole-chunk raster next to its
+        # OOM-split quarters): last writer wins in the product, which is
+        # never the silent outcome the user wants.
+        LOG.warning(
+            "%s: %d px covered by more than one chunk file — stale and "
+            "fresh chunk generations may be mixed (last file wins)",
+            out_path, overlap_px,
+        )
     if like_arr is not None:
         missing = int(((like_arr != 0) & ~covered).sum())
         if missing:
             LOG.warning(
-                "%s: %d valid pixels of %s are covered by no chunk file "
-                "— missing or half-written chunks; those pixels are zero",
-                out_path, missing, like,
+                "%s: %d valid pixels of the --like raster are covered "
+                "by no chunk file — missing or half-written chunks; "
+                "those pixels are zero",
+                out_path, missing,
             )
     elif not covered.all():
         # Without an authoritative grid this is only a hint: chunks whose
@@ -164,6 +196,7 @@ def main(argv=None):
     groups = discover(args.folder)
     if not groups:
         raise SystemExit(f"no chunk outputs found in {args.folder}")
+    like = read_geotiff(args.like) if args.like else None
     written = []
     for (param, date, unc), files in sorted(groups.items()):
         if args.param and param not in args.param:
@@ -174,7 +207,7 @@ def main(argv=None):
             continue
         name = f"{param}_{date}{'_unc' if unc else ''}.tif"
         out_path = os.path.join(outdir, name)
-        h, w = mosaic_files(files, out_path, like=args.like)
+        h, w = mosaic_files(files, out_path, like=like)
         LOG.info("%s: %d chunks -> %dx%d", name, len(files), h, w)
         written.append({"file": name, "chunks": len(files),
                         "shape": [h, w]})
@@ -182,11 +215,7 @@ def main(argv=None):
     return written
 
 
-def console():
-    """Console-script entry point: main returns a result object for
-    programmatic callers; sys.exit must see 0 on success."""
-    main()
-    return 0
+console = make_console(main)
 
 
 if __name__ == "__main__":

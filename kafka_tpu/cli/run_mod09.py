@@ -23,6 +23,7 @@ import logging
 
 from ..engine.config import RunConfig
 from ..engine.priors import KERNEL_PARAMETER_LIST
+from . import make_console
 from .drivers import run_config
 
 
@@ -68,11 +69,7 @@ def main(argv=None):
     return stats
 
 
-def console():
-    """Console-script entry point: main returns a result object for
-    programmatic callers; sys.exit must see 0 on success."""
-    main()
-    return 0
+console = make_console(main)
 
 
 if __name__ == "__main__":

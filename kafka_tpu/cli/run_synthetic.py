@@ -30,6 +30,7 @@ from ..io import GeoTIFFOutput, read_geotiff
 from ..obsops import IdentityOperator, TwoStreamOperator, WCMAux, WCMOperator
 from ..testing.fixtures import DEFAULT_GEO, make_pivot_mask
 from ..testing.synthetic import SyntheticObservations
+from . import make_console
 
 import jax.numpy as jnp
 
@@ -184,11 +185,7 @@ def main(argv=None):
     return summary
 
 
-def console():
-    """Console-script entry point: main returns a result object for
-    programmatic callers; sys.exit must see 0 on success."""
-    main()
-    return 0
+console = make_console(main)
 
 
 if __name__ == "__main__":

@@ -38,12 +38,23 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
         or os.environ.get("KAFKA_TPU_COMPILE_CACHE_DIR")
         or _DEFAULT_DIR
     )
+    # Scope by platform configuration WITHOUT initializing a backend
+    # (jax.default_backend() would lock backend/distributed setup and
+    # pay full device-client initialization even for --help): processes
+    # pinned to CPU (tests) and processes with the device plugin
+    # (drivers, bench) get separate caches, because XLA:CPU AOT
+    # artifacts written under one configuration warn — and could
+    # SIGILL — when loaded under another.
+    scope = os.environ.get("JAX_PLATFORMS", "").strip() or "default"
+    path = os.path.join(path, scope.replace(",", "-"))
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
-        # Cache everything: the eager-op compiles a run performs once per
-        # process are exactly the ones worth never repeating.
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        # 0.5 s threshold: tunneled-TPU compiles (0.5 s even for trivial
+        # eager ops, ~10 s for the solver programs) all cache; sub-100 ms
+        # host-CPU compiles don't — XLA:CPU AOT entries are the ones that
+        # warn about machine-feature mismatches at load time.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except (AttributeError, ValueError, OSError) as e:
         LOG.info("compilation cache unavailable: %s", e)
