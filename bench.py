@@ -175,6 +175,9 @@ def bench_end_to_end(ny: int = 204, nx: int = 235, n_dates: int = 3,
             prior.parameter_list, list(DEFAULT_GEO.geotransform),
             DEFAULT_GEO.projection, folder=f"{tmp}/out",
             epsg=DEFAULT_GEO.epsg, async_writes=True,
+            # Fast-wire opt-in (the benchmarked performance mode; the
+            # DEFAULT wire is bit-exact float32 — io.output).
+            wire_dtype="float16",
         )
         kf = KalmanFilter(
             obs, output, mask, prior.parameter_list,

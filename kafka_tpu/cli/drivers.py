@@ -56,6 +56,27 @@ def prosail_aux_builder(metadata, gather):
     )
 
 
+def make_run_mesh(cfg: RunConfig):
+    """The chunk-level pixel mesh per ``RunConfig.device_mesh``: all LOCAL
+    devices (the ICI axis — chips of this host's slice), or None.  Chunks
+    stay the DCN/process axis via the scheduler."""
+    mode = getattr(cfg, "device_mesh", "auto")
+    if mode not in ("auto", "local", "none"):
+        raise ValueError(
+            f"device_mesh={mode!r}: expected 'auto', 'local' or 'none'"
+        )
+    if mode == "none":
+        return None
+    import jax
+
+    devices = jax.local_devices()
+    if mode == "auto" and len(devices) < 2:
+        return None
+    from ..shard.mesh import make_pixel_mesh
+
+    return make_pixel_mesh(devices)
+
+
 def run_one_chunk(
     cfg: RunConfig,
     chunk,
@@ -109,6 +130,7 @@ def run_one_chunk(
         hessian_correction=cfg.hessian_correction,
         prefetch_depth=cfg.prefetch_depth,
         scan_window=cfg.scan_window,
+        mesh=make_run_mesh(cfg),
     )
     kf.set_trajectory_model()
     q = cfg.q_diag if cfg.q_diag is not None else np.zeros(cfg.n_params)
@@ -309,7 +331,7 @@ def run_one_chunk_resilient(
                 cfg, chunk, prefix, full_mask, geo, aux_builder,
                 operator=operator,
             )
-            _remove_outputs(cfg, [f"*_{prefix}[abcd]*.tif"])
+            _remove_outputs(cfg, [f"*_{prefix}-[abcd]*.tif"])
             return result
         except Exception as exc:  # noqa: BLE001 — filtered to OOM below
             if not _is_oom(exc):
@@ -333,7 +355,7 @@ def run_one_chunk_resilient(
         # Symmetric to the pre-split cleanup: a full-chunk success must
         # remove quarter outputs left by an earlier crashed split of the
         # same chunk, or mosaics double-read those pixels.
-        _remove_outputs(cfg, [f"*_{prefix}[abcd]*.tif"])
+        _remove_outputs(cfg, [f"*_{prefix}-[abcd]*.tif"])
         return summary
     if rc != OOM_EXIT_CODE:
         raise RuntimeError(
@@ -359,8 +381,12 @@ def run_one_chunk_resilient(
     }
     any_ran = False
     for tag, sub in zip("abcd", split_chunk(chunk)):
+        # Dash separator: a bare hex append would collide with larger
+        # runs' chunk ids (prefix '1000' + 'a' == chunk '1000a'), and the
+        # success-path cleanup glob could then delete a sibling chunk's
+        # outputs.
         s = run_one_chunk_resilient(
-            cfg, sub, prefix + tag, full_mask, geo, aux_builder,
+            cfg, sub, f"{prefix}-{tag}", full_mask, geo, aux_builder,
             operator=operator, max_splits=max_splits - 1,
         )
         if s is not None:

@@ -36,9 +36,11 @@ from . import make_console
 LOG = logging.getLogger(__name__)
 
 #: ``{param}_{A%Y%j}_{prefix}[_unc].tif`` — prefix is the chunk id with
-#: optional a-d quarter suffixes from OOM splits.
+#: optional ``-a``..``-d`` quarter suffixes from OOM splits (the dash
+#: separator keeps hex chunk ids unambiguous: chunk ``1000a`` vs split
+#: quarter ``1000-a``; recursive splits nest as ``-a-c``...).
 _NAME = re.compile(
-    r"^(?P<param>.+)_(?P<date>A\d{7})_(?P<prefix>[0-9a-fx]+)"
+    r"^(?P<param>.+)_(?P<date>A\d{7})_(?P<prefix>[0-9a-fx]+(?:-[abcd])*)"
     r"(?P<unc>_unc)?\.tif$"
 )
 

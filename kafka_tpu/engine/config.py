@@ -136,13 +136,22 @@ class RunConfig:
     state_mask: Optional[str] = None
     observations: str = "synthetic"
     pad_multiple: int = 256
+    #: single-process multi-chip execution: "auto" shards every chunk's
+    #: pixel batch over a mesh of this process's local devices when there
+    #: is more than one (a v5e-8 host runs each chunk on all 8 chips from
+    #: ONE process), "local" forces the mesh even on one device, "none"
+    #: disables sharding.  The DCN/process axis stays with the chunk
+    #: scheduler — together they are the reference's dask fan-out
+    #: (``kafka_test_Py36.py:242-255``) mapped to ICI + DCN (SURVEY §2.3).
+    device_mesh: str = "auto"
     hessian_correction: bool = False
     #: double-buffered observation prefetch depth; 0 = synchronous reads
     prefetch_depth: int = 2
-    #: device->host wire format for output rasters ("float16" halves the
-    #: transfer bytes at <=2^-11 relative quantisation; "float32" is
-    #: bit-exact — see ``io.output.GeoTIFFOutput``)
-    wire_dtype: str = "float16"
+    #: device->host wire format for output rasters: "float32" (default)
+    #: is bit-exact like the reference's outputs; "float16" is the opt-in
+    #: fast wire (halves transfer bytes, <=2^-11 relative quantisation,
+    #: sigma clamped to 65504 — see ``io.output.GeoTIFFOutput``)
+    wire_dtype: str = "float32"
     #: temporal fusion: consecutive single-observation windows run as one
     #: lax.scan program in blocks of up to this many; 1 disables
     scan_window: int = 8

@@ -65,11 +65,26 @@ class KalmanFilter:
         hessian_correction: bool = False,
         prefetch_depth: int = 2,
         scan_window: int = 8,
+        mesh=None,
+        mesh_lane: int = 128,
     ):
         self.observations = observations
         self.output = output
         self.parameter_list = tuple(parameter_list)
         self.n_params = len(self.parameter_list)
+        # Multi-chip execution: with a ``jax.sharding.Mesh`` the engine
+        # commits every pixel-batched array (state, band batches, per-pixel
+        # aux, priors) to a pixel-axis NamedSharding, so the SAME jitted
+        # per-date program partitions across all mesh devices under GSPMD —
+        # the ICI half of the reference's fan-out axis
+        # (``kafka_test_Py36.py:242-255`` -> SURVEY §2.3), with zero
+        # collectives in the solve beyond the scalar convergence norm.
+        # ``mesh_lane`` keeps every device shard a multiple of the VPU lane
+        # width (128 on TPU; tests use smaller lanes on CPU meshes).
+        self.mesh = mesh
+        if mesh is not None:
+            quantum = int(mesh.devices.size) * int(mesh_lane)
+            pad_multiple = int(np.lcm(int(pad_multiple), quantum))
         self.gather = make_pixel_gather(state_mask, pad_multiple)
         self._state_propagator = state_propagation
         self.prior = prior
@@ -124,6 +139,75 @@ class KalmanFilter:
         self.trajectory_uncertainty = jnp.asarray(q)
 
     # ------------------------------------------------------------------
+    # mesh sharding
+    # ------------------------------------------------------------------
+
+    def _px_sharding(self, batch_axis: int, ndim: int):
+        from ..shard.mesh import pixel_sharding
+
+        return pixel_sharding(self.mesh, batch_axis, ndim)
+
+    def _aux_axis_flags(self, operator, aux):
+        """Flattened aux leaves + per-leaf pixel-axis flags (0 = split on
+        pixels, None = replicate), deferring to the operator's own
+        ``aux_in_axes`` contract; plain callables fall back to the shared
+        leading-axis heuristic (``obsops.protocol._aux_in_axes``)."""
+        n_pad = self.gather.n_pad
+        leaves, treedef = jax.tree.flatten(aux)
+        if hasattr(operator, "aux_in_axes"):
+            axes_tree = operator.aux_in_axes(aux, n_pad)
+        else:
+            from ..obsops.protocol import _aux_in_axes
+
+            axes_tree = _aux_in_axes(aux, n_pad)
+        return leaves, treedef, treedef.flatten_up_to(axes_tree)
+
+    def _put_pixel(self, arr):
+        """Commit a pixel-leading array to the mesh (no-op without one)."""
+        if self.mesh is None or arr is None:
+            return arr
+        return jax.device_put(arr, self._px_sharding(0, np.ndim(arr)))
+
+    def _shard_obs(self, obs: DateObservation) -> DateObservation:
+        """Commit a fetched observation to the mesh: band batches split on
+        their pixel axis, aux leaves split or replicated per the operator's
+        own ``aux_in_axes`` contract (a weight matrix whose leading dim
+        happens to equal n_pix must be replicated, not split)."""
+        if self.mesh is None:
+            return obs
+        bnd = self._px_sharding(1, 2)
+        bands = BandBatch(
+            y=jax.device_put(obs.bands.y, bnd),
+            r_inv=jax.device_put(obs.bands.r_inv, bnd),
+            mask=jax.device_put(obs.bands.mask, bnd),
+        )
+        aux = self._put_aux(obs.operator, obs.aux)
+        return obs._replace(bands=bands, aux=aux)
+
+    def _put_aux(self, operator, aux, stacked=None, batch_offset=0):
+        """Commit an aux pytree to the mesh: per-pixel leaves split on
+        their pixel axis, the rest replicated.  ``stacked`` (with
+        ``batch_offset=1``) handles the fused path, where leaves gained a
+        leading window axis but the per-pixel/broadcast decision must be
+        taken from the UNstacked template ``aux``."""
+        if aux is None:
+            return None if stacked is None else stacked
+        from ..shard.mesh import replicated
+
+        leaves, treedef, axes = self._aux_axis_flags(operator, aux)
+        if stacked is not None:
+            leaves = treedef.flatten_up_to(stacked)
+        rep = replicated(self.mesh)
+        return jax.tree.unflatten(treedef, [
+            jax.device_put(
+                leaf,
+                self._px_sharding(batch_offset, np.ndim(leaf))
+                if ax == 0 else rep,
+            )
+            for leaf, ax in zip(leaves, axes)
+        ])
+
+    # ------------------------------------------------------------------
     # the time loop
     # ------------------------------------------------------------------
 
@@ -136,6 +220,8 @@ class KalmanFilter:
             prior_mean, prior_inv = self.prior.process_prior(
                 date, self.gather
             )
+            prior_mean = self._put_pixel(prior_mean)
+            prior_inv = self._put_pixel(prior_inv)
         return prop.advance(
             x_analysis, p_analysis, p_analysis_inverse,
             self.trajectory_model, self.trajectory_uncertainty,
@@ -150,7 +236,9 @@ class KalmanFilter:
                 return hit
         if self._prefetcher is not None:
             return self._prefetcher.get(date)
-        return self.observations.get_observations(date, self.gather)
+        return self._shard_obs(
+            self.observations.get_observations(date, self.gather)
+        )
 
     def assimilate_dates(self, dates, x_forecast, p_forecast,
                          p_forecast_inverse):
@@ -234,6 +322,18 @@ class KalmanFilter:
             p_forecast_inverse = jnp.asarray(
                 p_forecast_inverse, jnp.float32
             )
+        if x_forecast.shape[0] != self.gather.n_pad:
+            # States checkpointed under a different padding (pre-mesh
+            # checkpoints, or a host exposing a different device count
+            # changing the mesh lcm) carry the same n_valid real pixels in
+            # their leading rows — re-pad rather than fail mid-resume.
+            x_forecast, p_forecast, p_forecast_inverse = self._repad(
+                x_forecast, p_forecast, p_forecast_inverse
+            )
+        if self.mesh is not None:
+            x_forecast = self._put_pixel(x_forecast)
+            p_forecast_inverse = self._put_pixel(p_forecast_inverse)
+            p_forecast = self._put_pixel(p_forecast)
         # Snapshot the grid windowing ONCE: the run loop and the prefetch
         # plan must see the identical date sequence even if the source's
         # `dates` property recomputes between reads (else a plan/loop
@@ -254,6 +354,9 @@ class KalmanFilter:
                 self._prefetcher = ObservationPrefetcher(
                     self.observations, self.gather, plan,
                     depth=depth,
+                    transform=(
+                        self._shard_obs if self.mesh is not None else None
+                    ),
                 )
         try:
             with trace(profile_dir):
@@ -265,6 +368,53 @@ class KalmanFilter:
             if self._prefetcher is not None:
                 self._prefetcher.close()
                 self._prefetcher = None
+
+    def _repad(self, x, p_f, p_inv):
+        """Re-pad a pixel-state triple to this gather's ``n_pad``: the
+        leading ``n_valid`` rows are the real pixels (PixelGather layout
+        invariant), new padding rows get zero state and identity
+        information — inert in every solve, never scattered out."""
+        n_valid, n_pad, p = self.gather.n_valid, self.gather.n_pad, \
+            self.n_params
+        if x.shape[0] < n_valid:
+            raise ValueError(
+                f"state has {x.shape[0]} rows but the mask holds "
+                f"{n_valid} valid pixels — not a state of this chunk"
+            )
+        if x.shape[0] == self.gather.mask.size and \
+                self.gather.mask.size != n_valid:
+            # A row per raster cell is NOT PixelGather layout — slicing
+            # its first n_valid rows would silently scramble pixels.
+            raise ValueError(
+                f"state has one row per raster cell ({x.shape[0]}); "
+                "expected PixelGather layout (valid pixels first) — "
+                "gather it with PixelGather.gather before run()"
+            )
+        LOG.info(
+            "re-padding state from %d to %d rows (%d valid pixels)",
+            x.shape[0], n_pad, n_valid,
+        )
+        n_fill = n_pad - n_valid
+
+        def pad2(a):
+            return jnp.concatenate([
+                jnp.asarray(a, jnp.float32)[:n_valid],
+                jnp.zeros((n_fill, p), jnp.float32),
+            ])
+
+        def pad3(a, fill):
+            return jnp.concatenate([
+                jnp.asarray(a, jnp.float32)[:n_valid],
+                jnp.broadcast_to(
+                    fill * jnp.eye(p, dtype=jnp.float32), (n_fill, p, p)
+                ),
+            ])
+
+        return (
+            pad2(x),
+            None if p_f is None else pad3(p_f, 1.0),
+            None if p_inv is None else pad3(p_inv, 1.0),
+        )
 
     # ------------------------------------------------------------------
     # temporal fusion (lax.scan over consecutive windows)
@@ -342,6 +492,8 @@ class KalmanFilter:
             prior_mean, prior_inv = self.prior.process_prior(
                 block[0][0], self.gather
             )
+            prior_mean = self._put_pixel(prior_mean)
+            prior_inv = self._put_pixel(prior_inv)
         first = block[0][1]
         opts = dict(self.solver_options or {})
         if "state_bounds" not in opts and \
@@ -371,6 +523,22 @@ class KalmanFilter:
             aux_stacked = jax.tree.map(
                 lambda *ls: jnp.stack([jnp.asarray(l) for l in ls]),
                 *[o.aux for _, o in block],
+            )
+        if self.mesh is not None:
+            # Normalise the stacked shardings: bands (K, n_bands, n_pix)
+            # split on the pixel axis; aux leaves that were per-pixel
+            # before stacking (axis 0 -> now axis 1) likewise, the rest
+            # replicated.  The per-date inputs were already committed by
+            # _shard_obs, so these puts are cheap layout confirmations.
+            bnd3 = self._px_sharding(2, 3)
+            bands = BandBatch(
+                y=jax.device_put(bands.y, bnd3),
+                r_inv=jax.device_put(bands.r_inv, bnd3),
+                mask=jax.device_put(bands.mask, bnd3),
+            )
+            aux_stacked = self._put_aux(
+                first.operator, first.aux, stacked=aux_stacked,
+                batch_offset=1,
             )
         x_fin, p_inv_fin, xs, diag_s, iters, norms = (
             assimilate_windows_scan(

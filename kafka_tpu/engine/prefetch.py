@@ -49,9 +49,14 @@ class ObservationPrefetcher:
         gather: PixelGather,
         dates: Sequence[datetime.datetime],
         depth: int = 2,
+        transform=None,
     ):
         self._source = source
         self._gather = gather
+        # Optional post-read hook run ON THE WORKER thread (e.g. the
+        # engine's mesh commit, ``KalmanFilter._shard_obs``) so the
+        # device upload/reshard overlaps the previous date's solve too.
+        self._transform = transform
         self._dates: List[datetime.datetime] = list(dates)
         self._queue: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._stopped = threading.Event()
@@ -66,6 +71,8 @@ class ObservationPrefetcher:
                 return
             try:
                 obs = self._source.get_observations(date, self._gather)
+                if self._transform is not None:
+                    obs = self._transform(obs)
             except BaseException as exc:  # re-raised at the caller's get()
                 self._queue.put((_SENTINEL_ERROR, exc))
                 return

@@ -35,7 +35,7 @@ class GeoTIFFOutput:
         async_writes: bool = False,
         predictor: int = 3,
         level: Optional[int] = None,
-        wire_dtype: str = "float16",
+        wire_dtype: str = "float32",
     ):
         self.parameter_list = tuple(parameter_list)
         self.geo = GeoInfo(
@@ -54,15 +54,17 @@ class GeoTIFFOutput:
         self.level = int(level) if level is not None else (
             1 if self.predictor == 3 else 6
         )
-        # Device->host wire format for DEVICE-array inputs.  "float16"
-        # halves the bytes crossing the (slow) device link; the on-disk
-        # rasters stay float32.  Quantisation is <= 2^-11 relative — two
-        # orders of magnitude below the 5% observation uncertainty every
-        # reader attaches to the data.  sigma is computed on-device;
-        # unobserved pixels (information ~0, sigma ~1e15 in the reference
-        # contract) overflow float16 to +inf, which still reads as "no
-        # information" to any threshold.  Set "float32" for bit-exact
-        # transfers; numpy inputs are never touched either way.
+        # Device->host wire format for DEVICE-array inputs.  "float32"
+        # (the default) is bit-exact, matching the reference's float32
+        # outputs.  "float16" is the opt-in fast wire: it halves the bytes
+        # crossing the (slow) device link — the on-disk rasters stay
+        # float32 — at <= 2^-11 relative quantisation, two orders of
+        # magnitude below the 5% observation uncertainty every reader
+        # attaches to the data.  Under float16 the device-computed sigma
+        # is clamped to the float16 max (65504) before the cast, so
+        # weakly-observed and unobserved pixels stay finite ("absurdly
+        # large sigma", thresholdable) instead of overflowing to +inf.
+        # numpy inputs are never touched either way.
         if wire_dtype not in ("float16", "float32"):
             raise ValueError(f"wire_dtype {wire_dtype!r}")
         self.wire_dtype = wire_dtype
@@ -123,9 +125,10 @@ class GeoTIFFOutput:
             if p_inv_diag is not None and \
                     not isinstance(p_inv_diag, np.ndarray):
                 sigma = 1.0 / jnp.sqrt(jnp.maximum(p_inv_diag, 1e-30))
-                # No clamp: unobserved pixels overflow to +inf, keeping
-                # the "absurdly large sigma" contract thresholdable.
-                unc = sigma.astype(jnp.float16)
+                # Clamp at float16 max: sigma in (65504, 1e15) — weakly
+                # observed pixels — must stay finite, not collapse to the
+                # same +inf as truly unobserved ones.
+                unc = jnp.minimum(sigma, 65504.0).astype(jnp.float16)
                 unc_is_sigma = True
         for arr in (x, unc):
             if arr is not None and hasattr(arr, "copy_to_host_async"):

@@ -138,6 +138,81 @@ def make_tip_problem(n_pix: int, seed: int = 0, sigma: float = 0.005,
     return op, bands, x0, p_inv0
 
 
+def run_tip_engine(
+    mesh=None,
+    scan_window: int = 1,
+    obs_days: Sequence[int] = (1, 3, 5, 7),
+    grid_days: Sequence[int] = (0, 2, 4, 6, 8),
+    mesh_lane: int = 8,
+    ny: int = 12,
+    nx: int = 14,
+    pad_multiple: int = 128,
+):
+    """A complete (tiny) TIP assimilation through the PRODUCTION engine —
+    ``KalmanFilter.run`` with prior-only advance, prefetch, optional
+    temporal fusion and optional mesh sharding.  Shared by the engine-mesh
+    parity tests and ``__graft_entry__.dryrun_multichip`` so the dryrun
+    exercises exactly the code path the drivers run.
+
+    Returns ``(kf, out, x_analysis, p_inv_analysis)``.  Observation draws
+    are keyed on (seed, date): two calls see identical data, so a sharded
+    and an unsharded run are directly comparable — PROVIDED both see the
+    same padded batch size (noise/mask draws have shape (n_bands, n_pad)).
+    When comparing against a mesh run whose device count does not divide
+    ``pad_multiple``, pass the mesh run's effective padding here:
+    ``np.lcm(128, n_devices * mesh_lane)``.
+    """
+    import jax.numpy as jnp
+
+    from ..core.propagators import PixelPrior
+    from ..engine import FixedGaussianPrior, KalmanFilter
+    from ..engine.priors import TIP_PARAMETER_LIST, jrc_prior
+    from ..obsops import TwoStreamOperator
+
+    def day(i):
+        return datetime.datetime(2021, 3, 1) + datetime.timedelta(days=i)
+
+    yy, xx = np.mgrid[:ny, :nx]
+    mask = (yy - ny / 2) ** 2 + (xx - nx / 2) ** 2 < (min(ny, nx) / 2.4) ** 2
+    op = TwoStreamOperator()
+    truth = np.broadcast_to(
+        np.asarray(jrc_prior().prior.mean), mask.shape + (7,)
+    ).copy()
+    truth[..., 6] = 0.45
+    obs = SyntheticObservations(
+        dates=[day(i) for i in obs_days],
+        operator=op,
+        truth_fn=lambda date: truth,
+        sigma=0.001,
+        mask_prob=0.05,
+    )
+    out = MemoryOutput()
+    base = jrc_prior()
+    mean = np.asarray(base.prior.mean)
+    sigma = np.full(7, 0.01, np.float32)
+    sigma[6] = 0.5
+    cov = np.diag(sigma**2).astype(np.float32)
+    prior = FixedGaussianPrior(
+        PixelPrior(
+            mean=jnp.asarray(mean), cov=jnp.asarray(cov),
+            inv_cov=jnp.asarray(np.linalg.inv(cov)),
+        ),
+        TIP_PARAMETER_LIST,
+    )
+    kf = KalmanFilter(
+        obs, out, mask, TIP_PARAMETER_LIST,
+        state_propagation=None, prior=prior, pad_multiple=pad_multiple,
+        solver_options={"relaxation": 0.7, "max_iterations": 40},
+        scan_window=scan_window, prefetch_depth=2,
+        mesh=mesh, mesh_lane=mesh_lane,
+    )
+    kf.set_trajectory_uncertainty(np.zeros(7))
+    x0, p_inv0 = prior.process_prior(None, kf.gather)
+    grid = [day(i) for i in grid_days]
+    x_a, _, p_inv_a = kf.run(grid, x0, None, p_inv0)
+    return kf, out, x_a, p_inv_a
+
+
 class MemoryOutput:
     """In-memory output sink (the finished ``KafkaOutputMemory``): stores
     per-parameter mean and sigma rasters keyed by timestep."""

@@ -137,9 +137,9 @@ class TestOutputWriter:
         np.testing.assert_allclose(unc[mask], 0.25, rtol=1e-6)
 
     def test_device_array_float16_wire(self, tmp_path):
-        """The default device path: float16 downcast, on-device sigma,
-        unobserved pixels overflowing to +inf (the 'absurdly large sigma'
-        contract, observations.py:393)."""
+        """The opt-in fast wire: float16 downcast, on-device sigma,
+        unobserved pixels clamped to the float16 max (finite 'absurdly
+        large sigma', still thresholdable — observations.py:393)."""
         import jax.numpy as jnp
 
         mask = np.ones((8, 16), bool)
@@ -148,9 +148,9 @@ class TestOutputWriter:
         p_inv_diag = np.full((gather.n_pad, 2), 16.0, np.float32)
         p_inv_diag[3, :] = 0.0  # an unobserved pixel
         out = GeoTIFFOutput(
-            ["lai", "sm"], (0, 10, 0, 0, 0, -10), folder=str(tmp_path)
+            ["lai", "sm"], (0, 10, 0, 0, 0, -10), folder=str(tmp_path),
+            wire_dtype="float16",
         )
-        assert out.wire_dtype == "float16"
         ts = datetime.datetime(2019, 6, 1)
         out.dump_data(ts, jnp.asarray(x), jnp.asarray(p_inv_diag),
                       gather, ["lai", "sm"])
@@ -160,8 +160,26 @@ class TestOutputWriter:
         )
         unc, _ = read_geotiff(str(tmp_path / "lai_A2019152_unc.tif"))
         expect = np.full(gather.n_valid, 0.25, np.float32)
-        expect[3] = np.inf
+        expect[3] = 65504.0  # clamped, finite, huge
         np.testing.assert_allclose(unc[mask], expect, rtol=1.5e-3)
+        assert np.isfinite(unc[mask]).all()
+
+    def test_default_wire_is_bit_exact_float32(self, tmp_path):
+        """The DEFAULT wire must be float32/bit-exact, matching the
+        reference's outputs without opt-in (round-2 advisor finding)."""
+        import jax.numpy as jnp
+
+        mask = np.ones((4, 8), bool)
+        gather = make_pixel_gather(mask, pad_multiple=32)
+        x = RNG.normal(size=(gather.n_pad, 1)).astype(np.float32)
+        out = GeoTIFFOutput(
+            ["a"], (0, 1, 0, 0, 0, -1), folder=str(tmp_path)
+        )
+        assert out.wire_dtype == "float32"
+        out.dump_data(datetime.datetime(2019, 6, 3), jnp.asarray(x),
+                      None, gather, ["a"])
+        mean, _ = read_geotiff(str(tmp_path / "a_A2019154.tif"))
+        np.testing.assert_array_equal(mean[mask], x[: gather.n_valid, 0])
 
     def test_device_array_float32_wire_exact(self, tmp_path):
         import jax.numpy as jnp
