@@ -86,8 +86,15 @@ def bench_device_sizes(sizes, ks=(5, 25)):
     return out
 
 
-def bench_oracle(n_pix: int, reps: int = 1) -> float:
-    """The reference algorithm (sparse block-diag + SuperLU) on host CPU."""
+def bench_oracle(n_pix: int, reps: int = 5):
+    """The reference algorithm (sparse block-diag + SuperLU) on host CPU.
+
+    Median of ``reps`` runs with the spread reported: the single-shot CPU
+    baseline swung 6.7x between rounds (host-load noise), which put error
+    bars of the same size on the headline speedup.  Returns
+    ``(pixels_per_sec_median, median_ms, spread_ms)`` where spread is
+    (max - min) over the reps.
+    """
     import jax
 
     from kafka_tpu.testing.oracle import iterated_sparse_solve
@@ -118,18 +125,21 @@ def bench_oracle(n_pix: int, reps: int = 1) -> float:
 
     x0_np = np.asarray(x0)
     p_inv_np = np.asarray(p_inv0)
-    t0 = time.perf_counter()
+    times = []
     for _ in range(reps):
+        t0 = time.perf_counter()
         _, _, n_iters = iterated_sparse_solve(
             linearize, y_b, r_b, m_b, x0_np, p_inv_np
         )
-    dt = (time.perf_counter() - t0) / reps
+        times.append(time.perf_counter() - t0)
+    dt = float(np.median(times))
+    spread = float(max(times) - min(times))
     print(
         f"oracle: {n_pix} px, {n_iters} GN iters, {dt*1e3:.1f} ms/solve "
-        f"(SciPy SuperLU)",
+        f"median of {reps} (spread {spread*1e3:.1f} ms, SciPy SuperLU)",
         file=sys.stderr,
     )
-    return n_pix / dt
+    return n_pix / dt, dt * 1e3, spread * 1e3
 
 
 def bench_end_to_end(ny: int = 204, nx: int = 235, n_dates: int = 3,
@@ -226,7 +236,7 @@ def main():
     # with both sizes reported.
     n_matched = 16384
     n_device = 1 << 19
-    base_px_s = bench_oracle(n_matched)
+    base_px_s, oracle_ms, oracle_spread_ms = bench_oracle(n_matched)
     dev = bench_device_sizes([n_matched, n_device])
     dev_matched_px_s = dev[n_matched]
     dev_px_s = dev[n_device]
@@ -236,6 +246,8 @@ def main():
         "value": round(dev_px_s, 1),
         "unit": "pixels/sec",
         "vs_baseline": round(dev_matched_px_s / base_px_s, 2),
+        "oracle_ms_median": round(oracle_ms, 1),
+        "oracle_ms_spread": round(oracle_spread_ms, 1),
         "n_pix_device": n_device,
         "n_pix_matched": n_matched,
         "device_px_s_matched": round(dev_matched_px_s, 1),

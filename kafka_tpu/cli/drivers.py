@@ -129,8 +129,10 @@ def run_one_chunk(
         solver_options=cfg.solver_options,
         hessian_correction=cfg.hessian_correction,
         prefetch_depth=cfg.prefetch_depth,
+        prefetch_workers=cfg.prefetch_workers,
         scan_window=cfg.scan_window,
         mesh=make_run_mesh(cfg),
+        checkpoint_every_n=cfg.checkpoint_every_n,
     )
     kf.set_trajectory_model()
     q = cfg.q_diag if cfg.q_diag is not None else np.zeros(cfg.n_params)

@@ -605,12 +605,19 @@ def _assimilate_scan_impl(
             x_n, batched_diagonal(p_inv_n),
             diags.n_iterations, diags.convergence_norm,
         )
+        # Per-pixel convergence masks stack along the window axis so the
+        # fused path keeps the same per-pixel diagnostics as the unfused
+        # one (a static structural difference: the mode is a static arg).
+        if per_pixel_convergence:
+            out = out + (diags.converged_mask,)
         return (x_n, p_inv_n), out
 
-    (x_fin, p_inv_fin), (xs, diag_s, iters, norms) = jax.lax.scan(
+    (x_fin, p_inv_fin), ys = jax.lax.scan(
         step, (x_analysis0, p_inv_analysis0), (obs_stacked, aux_stacked)
     )
-    return x_fin, p_inv_fin, xs, diag_s, iters, norms
+    xs, diag_s, iters, norms = ys[:4]
+    converged = ys[4] if per_pixel_convergence else None
+    return x_fin, p_inv_fin, xs, diag_s, iters, norms, converged
 
 
 def assimilate_windows_scan(
@@ -644,7 +651,9 @@ def assimilate_windows_scan(
     whose prior declares ``date_invariant``.
 
     Returns ``(x_final, p_inv_final, xs (K, n, p), p_inv_diags (K, n, p),
-    n_iterations (K,), convergence_norms (K,))``.
+    n_iterations (K,), convergence_norms (K,), converged_masks)`` — the
+    last a ``(K, n)`` bool array under ``per_pixel_convergence``, else
+    None.
     """
     opts = dict(solver_options or {})
     block = opts.pop("linearize_block", None)

@@ -147,6 +147,9 @@ class RunConfig:
     hessian_correction: bool = False
     #: double-buffered observation prefetch depth; 0 = synchronous reads
     prefetch_depth: int = 2
+    #: concurrent prefetch reader threads (ordered delivery); >1 overlaps
+    #: several dates' host I/O on multi-core hosts
+    prefetch_workers: int = 1
     #: device->host wire format for output rasters: "float32" (default)
     #: is bit-exact like the reference's outputs; "float16" is the opt-in
     #: fast wire (halves transfer bytes, <=2^-11 relative quantisation,
@@ -163,6 +166,11 @@ class RunConfig:
     #: ``.done`` markers.  ``extra["checkpoint_shards"]`` splits each
     #: checkpoint's pixel axis across that many files.
     checkpoint_folder: Optional[str] = None
+    #: save a checkpoint at most every N grid windows (the run's last
+    #: window always saves); 1 = every window (reference-faithful), larger
+    #: values trade resume granularity for less write traffic on the
+    #: annual-chain critical path
+    checkpoint_every_n: int = 1
     extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
@@ -242,6 +250,10 @@ class RunConfig:
                 relative_uncertainty=self.extra.get(
                     "relative_uncertainty", 0.05
                 ),
+                # ENL speckle statistics: a number, "auto" (per-scene
+                # estimate), or None (file attribute / 5% placeholder).
+                enl=self.extra.get("s1_enl"),
+                noise_floor=self.extra.get("s1_noise_floor", 0.0),
             )
         if self.observations == "joint":
             # Multi-sensor S2 optical + S1 SAR on the shared 11-parameter
@@ -272,6 +284,8 @@ class RunConfig:
                 relative_uncertainty=self.extra.get(
                     "s1_relative_uncertainty", 0.05
                 ),
+                enl=self.extra.get("s1_enl"),
+                noise_floor=self.extra.get("s1_noise_floor", 0.0),
             )
             return CompositeObservations([s2, s1])
         raise KeyError(

@@ -1,46 +1,50 @@
-"""Double-buffered observation prefetch.
+"""Multi-worker observation prefetch with ordered delivery.
 
 SURVEY.md §2.2 (raster row) requires the input pipeline to feed fixed-shape
 pixel blocks into device HBM ahead of the solve, the way the output side
 already hides GeoTIFF encoding behind ``GeoTIFFOutput``'s writer thread.
 The reference reads every band synchronously inside the time loop
 (``/root/reference/kafka/linear_kf.py:225-227`` — per band *and* per date,
-GDAL warp on the critical path); here a single worker thread walks the
-run's observation dates in order, performs the full host-side read/decode/
-warp/gather for date t+1 (including the ``jnp.asarray`` device upload the
-readers already do), and parks the result in a bounded queue while the
-device solves date t.
+GDAL warp on the critical path); here ``workers`` threads walk the run's
+observation dates, each performing the full host-side read/decode/warp/
+gather for its claimed date (plus the optional ``transform`` — e.g. the
+engine's mesh commit), and results are delivered strictly IN ORDER however
+the reads complete.
 
-The assimilation order is fully known before the loop starts (the time
-grid windows the observation dates deterministically), so prefetching is a
-straight pipeline, not speculation.  Queue depth 2 = classic double
-buffering; the worker blocks when the buffer is full, bounding host memory
-at ``depth`` gathered dates.
+In-flight results are bounded by ``depth`` (a semaphore slot per undelivered
+date), so host memory holds at most ``max(depth, workers)`` gathered dates.
+``workers=1`` reproduces the round-2 single-worker pipeline exactly; more
+workers overlap multiple dates' I/O — the win on hosts with several cores,
+where decode (GIL-free C++ codec) and warp parallelise across dates on top
+of the per-band pool inside each reader.
 """
 
 from __future__ import annotations
 
 import datetime
 import logging
-import queue
 import threading
-from typing import List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .protocols import DateObservation, ObservationSource
 from .state import PixelGather
 
 LOG = logging.getLogger(__name__)
 
-_SENTINEL_ERROR = object()
-
 
 class ObservationPrefetcher:
-    """Reads ``dates`` from ``source`` on a worker thread, in order.
+    """Reads ``dates`` from ``source`` on worker threads.
 
     ``get(date)`` returns the prefetched ``DateObservation`` for the next
     date in sequence — callers must consume dates in the order given
-    (the filter's time loop does).  Worker exceptions re-raise in the
-    caller at the ``get`` for the failing date.
+    (the filter's time loop does).  A worker exception re-raises in the
+    caller at the ``get`` for the failing date; later dates already in
+    flight may complete but nothing new is claimed after a failure.
+
+    With ``workers > 1`` the source's ``get_observations`` is called
+    CONCURRENTLY for different dates — sources must tolerate concurrent
+    pure reads (all in-repo sources do; see the threading contract on
+    ``ObservationSource``).
     """
 
     def __init__(
@@ -50,6 +54,7 @@ class ObservationPrefetcher:
         dates: Sequence[datetime.datetime],
         depth: int = 2,
         transform=None,
+        workers: int = 1,
     ):
         self._source = source
         self._gather = gather
@@ -58,49 +63,87 @@ class ObservationPrefetcher:
         # device upload/reshard overlaps the previous date's solve too.
         self._transform = transform
         self._dates: List[datetime.datetime] = list(dates)
-        self._queue: queue.Queue = queue.Queue(maxsize=max(1, depth))
-        self._stopped = threading.Event()
-        self._thread = threading.Thread(
-            target=self._worker, name="obs-prefetch", daemon=True
+        self._workers = max(1, int(workers))
+        self._slots = threading.Semaphore(
+            max(1, int(depth), self._workers)
         )
-        self._thread.start()
+        self._cond = threading.Condition()
+        #: idx -> ("ok", obs) | ("error", exc)
+        self._results: Dict[int, Tuple[str, Any]] = {}
+        self._next_claim = 0
+        self._next_emit = 0
+        self._stopped = threading.Event()
+        self._threads = [
+            threading.Thread(
+                target=self._worker, name=f"obs-prefetch-{i}", daemon=True
+            )
+            for i in range(self._workers)
+        ]
+        for t in self._threads:
+            t.start()
 
     def _worker(self) -> None:
-        for date in self._dates:
+        while True:
+            self._slots.acquire()
             if self._stopped.is_set():
                 return
+            with self._cond:
+                idx = self._next_claim
+                if idx >= len(self._dates):
+                    return
+                self._next_claim += 1
+            date = self._dates[idx]
             try:
                 obs = self._source.get_observations(date, self._gather)
                 if self._transform is not None:
                     obs = self._transform(obs)
+                item = ("ok", obs)
             except BaseException as exc:  # re-raised at the caller's get()
-                self._queue.put((_SENTINEL_ERROR, exc))
+                item = ("error", exc)
+            with self._cond:
+                self._results[idx] = item
+                if item[0] == "error":
+                    # Don't claim past a failure: the run is about to
+                    # abort at this date's get(); reading further dates
+                    # would waste I/O and hold memory.
+                    self._next_claim = len(self._dates)
+                self._cond.notify_all()
+            if item[0] == "error":
                 return
-            self._queue.put((date, obs))
 
     def get(self, date: datetime.datetime) -> DateObservation:
-        got, obs = self._queue.get()
-        if got is _SENTINEL_ERROR:
-            raise obs
-        if got != date:
+        with self._cond:
+            idx = self._next_emit
+            while idx not in self._results and not self._stopped.is_set():
+                self._cond.wait(timeout=0.5)
+            if idx not in self._results:
+                raise RuntimeError("prefetcher closed while waiting")
+            kind, payload = self._results.pop(idx)
+            self._next_emit += 1
+        self._slots.release()
+        if kind == "error":
+            raise payload
+        if self._dates[idx] != date:
             # Out-of-order consumption would silently assimilate the wrong
             # acquisition; fail loudly instead.
             raise RuntimeError(
-                f"prefetch order violation: requested {date}, queued {got}"
+                f"prefetch order violation: requested {date}, queued "
+                f"{self._dates[idx]}"
             )
-        return obs
+        return payload
 
     def close(self) -> None:
-        """Stop the worker; safe to call at any point (e.g. early abort)."""
+        """Stop the workers; safe to call at any point (early abort)."""
         self._stopped.set()
-        # Unblock a worker waiting on a full queue.
-        try:
-            while True:
-                self._queue.get_nowait()
-        except queue.Empty:
-            pass
-        self._thread.join(timeout=5.0)
-        if self._thread.is_alive():
+        with self._cond:
+            self._next_claim = len(self._dates)
+            self._cond.notify_all()
+        # Unblock workers parked on the slot semaphore.
+        for _ in self._threads:
+            self._slots.release()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        if any(t.is_alive() for t in self._threads):
             # A read longer than the join timeout is still in flight; it
             # holds file handles / host memory until it finishes.
             LOG.warning(

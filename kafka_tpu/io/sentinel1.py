@@ -84,6 +84,53 @@ def _read_nc_var(path: str, var: str):
     return arr, gt, crs
 
 
+def estimate_enl(arr: np.ndarray, missing: float = MISSING_VALUE,
+                 window: int = 15, quantile: float = 0.8
+                 ) -> Optional[float]:
+    """Equivalent number of looks from the image's own statistics.
+
+    For multi-looked intensity over a homogeneous area the speckle is
+    gamma-distributed with ``ENL = mean^2 / variance`` — the standard
+    moments estimator.  Real scenes mix homogeneous and textured areas;
+    texture adds variance, biasing individual windows LOW, so the
+    per-window ratio is computed over non-overlapping ``window x window``
+    blocks of fully-valid pixels and the scene ENL is a high quantile of
+    the block ratios — blocks near the top are the homogeneous ones.
+    (window=15/q=0.8 measured on synthetic gamma speckle: <~11% error on
+    homogeneous scenes, <~4% with half the scene strongly textured.)
+    The reference leaves this as an open TODO
+    (``Sentinel1_Observations.py:106-132``).
+
+    Returns None when fewer than 8 usable blocks exist (no reliable
+    estimate; callers fall back to the relative placeholder).
+    """
+    a = np.asarray(arr, np.float64)
+    if a.ndim == 3 and a.shape[-1] <= 4:
+        a = a[..., 0]  # trailing band axis (io.warp layout)
+    if a.ndim != 2:
+        return None
+    valid = np.isfinite(a) & (a != missing) & (a > 0)
+    ny, nx = a.shape[0], a.shape[1]
+    by, bx = ny // window, nx // window
+    if by == 0 or bx == 0:
+        return None
+    crop = a[: by * window, : bx * window]
+    vcrop = valid[: by * window, : bx * window]
+    blocks = crop.reshape(by, window, bx, window).swapaxes(1, 2)
+    vblocks = vcrop.reshape(by, window, bx, window).swapaxes(1, 2)
+    full = vblocks.all(axis=(2, 3))
+    if full.sum() < 8:
+        return None
+    m = blocks.mean(axis=(2, 3))
+    v = blocks.var(axis=(2, 3), ddof=1)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        ratio = np.where(v > 0, m * m / v, np.nan)
+    ratio = ratio[full & np.isfinite(ratio)]
+    if ratio.size < 8:
+        return None
+    return float(np.quantile(ratio, quantile))
+
+
 class S1Observations:
     """ObservationSource over a folder of preprocessed S1 sigma0 NetCDFs.
 
@@ -104,10 +151,12 @@ class S1Observations:
         self.state_geotransform, self.state_crs = state_geo
         self.operator = operator if operator is not None else WCMOperator()
         self.relative_uncertainty = float(relative_uncertainty)
-        #: equivalent number of looks for speckle-statistics uncertainty;
+        #: equivalent number of looks for speckle-statistics uncertainty:
+        #: a number uses that ENL; ``"auto"`` estimates it per scene from
+        #: the image's own homogeneous-block statistics (``estimate_enl``);
         #: None = use the file's ``enl`` attribute, or fall back to the
         #: reference's relative placeholder.
-        self.enl = None if enl is None else float(enl)
+        self.enl = enl if enl is None or enl == "auto" else float(enl)
         #: noise-equivalent sigma0 (linear power units) added in
         #: quadrature to the speckle term.
         self.noise_floor = float(noise_floor)
@@ -127,8 +176,9 @@ class S1Observations:
         # One warp mapping per (source grid, dst shape) — shared by
         # VV/VH/theta of a scene (see sentinel2.py mapping cache).
         self._mapping_cache: Dict[tuple, tuple] = {}
-        # File-level ``enl`` attributes are immutable: read once per path.
-        self._enl_cache: Dict[str, Optional[float]] = {}
+        # File-level ``enl`` attributes and per-scene auto estimates are
+        # immutable: read/estimate once per path.
+        self._enl_cache: Dict[Any, Optional[float]] = {}
 
     def define_output(self):
         return self.state_crs, list(self.state_geotransform)
@@ -159,10 +209,33 @@ class S1Observations:
         self._enl_cache[path] = enl
         return enl
 
+    def _auto_enl(self, path: str) -> Optional[float]:
+        """Scene ENL estimated from the native-grid VV intensity (cached
+        per file; estimated BEFORE warping — resampling correlates
+        neighbouring pixels and would bias the moments estimator)."""
+        key = ("auto", path)
+        if key in self._enl_cache:
+            return self._enl_cache[key]
+        arr, _, _ = _read_nc_var(path, f"sigma0_{POLARISATIONS[0]}")
+        enl = estimate_enl(arr)
+        if enl is None:
+            LOG.warning(
+                "%s: too few homogeneous blocks for an ENL estimate; "
+                "falling back to the %.0f%% relative placeholder",
+                path, 100 * self.relative_uncertainty,
+            )
+        else:
+            LOG.info("%s: estimated ENL %.1f", path, enl)
+        self._enl_cache[key] = enl
+        return enl
+
     def get_observations(self, date, gather: PixelGather) -> DateObservation:
         path = self.date_data[date]
         dst_shape = gather.mask.shape
-        enl = self.enl if self.enl is not None else self._file_enl(path)
+        if self.enl == "auto":
+            enl = self._auto_enl(path)
+        else:
+            enl = self.enl if self.enl is not None else self._file_enl(path)
         ys, r_invs, masks = [], [], []
         for pol in POLARISATIONS:
             sigma0 = self._warp_var(

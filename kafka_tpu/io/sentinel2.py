@@ -115,6 +115,7 @@ class Sentinel2Observations:
         state_geo,
         aux_builder: Optional[Callable] = None,
         relative_uncertainty: float = 0.05,
+        band_workers: Optional[int] = None,
     ):
         if not os.path.exists(parent_folder):
             raise IOError("S2 data folder doesn't exist")
@@ -125,6 +126,20 @@ class Sentinel2Observations:
             lambda metadata, gather: metadata
         )
         self.relative_uncertainty = float(relative_uncertainty)
+        # Per-date band parallelism: the 10 read->decode->warp->gather
+        # chains are independent and the tile codec's inner loops are
+        # GIL-free (C++/zlib), so they thread across host cores.  Default:
+        # one worker per core up to the band count; 1 = the reference's
+        # serial per-band loop (linear_kf.py:225-227).
+        if band_workers is None:
+            band_workers = min(len(BAND_MAP), os.cpu_count() or 1)
+        self.band_workers = max(1, int(band_workers))
+        # ONE pool for the source's lifetime (lazily built): an annual
+        # run reads hundreds of dates — spawning/joining threads per
+        # date, times N prefetch workers, would put thread churn on the
+        # exact host path this pool exists to speed up.  submit() is
+        # thread-safe, so concurrent prefetch readers share it.
+        self._band_pool = None
         self._find_granules()
         self.bands_per_observation = {d: len(BAND_MAP) for d in self.dates}
         # (src_gt, src_crs, dst_shape) -> fractional-pixel warp mapping.
@@ -170,14 +185,15 @@ class Sentinel2Observations:
         (``Sentinel2_Observations.py:100-113``)."""
         return self.state_crs, list(self.state_geotransform)
 
-    def _warp_band(self, path: str, dst_shape) -> np.ndarray:
-        """Warp one band file onto the state grid, reading only the source
-        window the state grid actually maps into — a chunked run over a
-        10980x10980 tile decodes chunk-sized windows, not whole bands
-        (the streaming-read property of the reference's ``gdal.Warp``)."""
+    def _band_info(self, path: str):
         info = self._info_cache.get(path)
         if info is None:
             info = self._info_cache[path] = read_info(path)
+        return info
+
+    def _ensure_mapping(self, info, dst_shape):
+        """The (cached) fractional-pixel mapping of the state grid into
+        one source grid — the expensive CRS transform, no pixel I/O."""
         src_crs = info.geo.epsg if info.geo.epsg else self.state_crs
         key = (tuple(info.geo.geotransform), src_crs, tuple(dst_shape))
         if key not in self._mapping_cache:
@@ -195,12 +211,36 @@ class Sentinel2Observations:
             self._mapping_cache[key] = (
                 col_f - c0, row_f - r0, r0, c0, r1 - r0, c1 - c0
             )
-        col_l, row_l, r0, c0, nr, nc = self._mapping_cache[key]
+        return self._mapping_cache[key]
+
+    def _warp_band(self, path: str, dst_shape) -> np.ndarray:
+        """Warp one band file onto the state grid, reading only the source
+        window the state grid actually maps into — a chunked run over a
+        10980x10980 tile decodes chunk-sized windows, not whole bands
+        (the streaming-read property of the reference's ``gdal.Warp``)."""
+        info = self._band_info(path)
+        col_l, row_l, r0, c0, nr, nc = self._ensure_mapping(
+            info, dst_shape
+        )
         win, _ = read_geotiff_window(path, r0, c0, nr, nc, info=info)
         return resample(
             win if win.ndim == 2 else win[..., 0],
             col_l, row_l, method="nearest", nodata=0.0,
         )
+
+    def _band_arrays(self, path: str, dst_shape, gather: PixelGather):
+        """One band's full host chain: read window -> decode -> warp ->
+        gather -> reflectance/uncertainty arrays."""
+        rho = self._warp_band(path, dst_shape).astype(np.float32)
+        rho_pix = gather.gather(rho)
+        mask = (rho_pix > 0) & gather.valid
+        # DN/10000 reflectance, 5% relative sigma, inverse variance
+        # (Sentinel2_Observations.py:167-179).
+        refl = np.where(mask, rho_pix / 10000.0, 0.0).astype(np.float32)
+        sigma = self.relative_uncertainty * refl
+        with np.errstate(divide="ignore"):
+            r_inv = np.where(mask, 1.0 / sigma**2, 0.0)
+        return refl, r_inv.astype(np.float32), mask
 
     def get_observations(self, date, gather: PixelGather) -> DateObservation:
         folder = self.date_data[date]
@@ -208,22 +248,35 @@ class Sentinel2Observations:
         sza, saa, vza, vaa = parse_s2_xml(meta_file)
         metadata = {"sza": sza, "saa": saa, "vza": vza, "vaa": vaa}
 
-        ys, r_invs, masks = [], [], []
         dst_shape = gather.mask.shape
-        for band in BAND_MAP:
-            path = os.path.join(folder, f"B{band}_sur.tif")
-            rho = self._warp_band(path, dst_shape).astype(np.float32)
-            rho_pix = gather.gather(rho)
-            mask = (rho_pix > 0) & gather.valid
-            # DN/10000 reflectance, 5% relative sigma, inverse variance
-            # (Sentinel2_Observations.py:167-179).
-            refl = np.where(mask, rho_pix / 10000.0, 0.0).astype(np.float32)
-            sigma = self.relative_uncertainty * refl
-            with np.errstate(divide="ignore"):
-                r_inv = np.where(mask, 1.0 / sigma**2, 0.0)
-            ys.append(refl)
-            r_invs.append(r_inv.astype(np.float32))
-            masks.append(mask)
+        paths = [
+            os.path.join(folder, f"B{band}_sur.tif") for band in BAND_MAP
+        ]
+        if self.band_workers > 1:
+            # Warm the per-grid caches serially first: all bands of a
+            # granule typically share one source grid, and N threads
+            # discovering a cold mapping would each recompute the (one
+            # expensive) CRS transform.  Header reads are cheap; no
+            # pixel I/O happens here.
+            for path in paths:
+                self._ensure_mapping(self._band_info(path), dst_shape)
+            if self._band_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._band_pool = ThreadPoolExecutor(
+                    self.band_workers, thread_name_prefix="s2-band"
+                )
+            results = list(self._band_pool.map(
+                lambda p: self._band_arrays(p, dst_shape, gather),
+                paths,
+            ))
+        else:
+            results = [
+                self._band_arrays(p, dst_shape, gather) for p in paths
+            ]
+        ys = [r[0] for r in results]
+        r_invs = [r[1] for r in results]
+        masks = [r[2] for r in results]
 
         bands = BandBatch(
             y=jnp.asarray(np.stack(ys)),
@@ -239,18 +292,27 @@ class Sentinel2Observations:
 def find_nearest_geometry(available, sza: float, vza: float, raa: float):
     """Pick the closest (sza, vza, raa) key from an emulator bank — the
     per-geometry emulator selection of the reference
-    (``Sentinel2_Observations.py:133-145``), which matches each axis to its
-    nearest available grid value independently."""
+    (``Sentinel2_Observations.py:133-145``), which matches each axis to
+    its nearest available grid value independently.
+
+    On a complete angular grid the per-axis match lands on an existing
+    key (the reference's assumption).  On an INCOMPLETE bank the axes can
+    disagree — each axis's nearest value exists, but their combination is
+    no actual bank — so the fallback picks the nearest EXISTING key, with
+    each axis normalised by its grid span (raw degrees would let the wide
+    relative-azimuth axis, 0-180, swamp the zenith axes, 20-60)."""
     keys = list(available)
     arr = np.asarray(keys, np.float64)  # (m, 3): sza, vza, raa
     e1 = arr[:, 0] == arr[np.argmin(np.abs(arr[:, 0] - sza)), 0]
     e2 = arr[:, 1] == arr[np.argmin(np.abs(arr[:, 1] - vza)), 1]
     e3 = arr[:, 2] == arr[np.argmin(np.abs(arr[:, 2] - raa)), 2]
     hits = np.where(e1 & e2 & e3)[0]
-    idx = int(hits[0]) if hits.size else int(
-        np.argmin(np.abs(arr - [sza, vza, raa]).sum(axis=1))
-    )
-    return keys[idx]
+    if hits.size:
+        return keys[int(hits[0])]
+    span = arr.max(axis=0) - arr.min(axis=0)
+    span[span <= 0] = 1.0
+    dist = (np.abs(arr - [sza, vza, raa]) / span).sum(axis=1)
+    return keys[int(np.argmin(dist))]
 
 
 def geometry_bank_aux_builder(banks: Dict[tuple, Any]) -> Callable:

@@ -27,6 +27,12 @@ from .gp import (
     save_gp,
     stack_gp_bank,
 )
+from .gp_import import (
+    gp_params_from_emulator,
+    load_emulator_bank_file,
+    load_emulator_directory,
+    load_emulator_pickle,
+)
 from .mlp import MLPOperator, fit_mlp, mlp_apply
 from .joint import (
     ProsailJointOperator,

@@ -37,16 +37,18 @@ Calibration status (tests/test_prosail_calibration.py):
   ODE system to <2e-3 across leaf/soil/LAI/LIDF regimes;
 - the **plate model matches a float64 SciPy-``exp1`` oracle** to <2e-3
   (validating the branch-free E1 approximation under float32);
-- the per-band constituent absorption coefficients (``BAND_K``) are
-  *band-effective* values for the 10 S2 bands of the reference's band map
-  (B02..B8A, B09, B12), tuned so the canonical dense-canopy state
-  (N=1.5, Cab=40, Car=8, Cw=0.0176, Cm=0.009, LAI=3) lands inside the
-  published per-band reflectance windows of healthy vegetation (NIR
-  plateau 0.30-0.55, red < 0.07, red edge monotone, NDVI 0.75-0.97) with
-  the right sensitivity directions (Cab -> red, Cw -> SWIR, LAI -> NIR).
-  No full-spectrum PROSPECT-5 table ships in this environment; refitting
-  ``BAND_K``/``N_REFRACT``/soil spectra against one is a drop-in constant
-  swap that touches no model code.
+- the spectral inputs (``BAND_K``/``N_REFRACT``/soil) are generated in
+  ``obsops.prospect_data`` from published fine-grid physical data
+  (refractive-index curve, liquid-water absorption magnitudes, pigment
+  band decompositions, dry-matter SWIR rise) band-averaged over
+  flat-top approximations of the Sentinel-2A spectral response
+  functions, and are regression-locked against QUANTITATIVE per-band
+  canonical targets: fresh/dry/chlorotic leaf reflectance and dense-
+  canopy BRF anchors per band, NIR chlorophyll transparency, and the
+  945/2202 nm water-band magnitudes.  No PROSPECT-5 coefficient file
+  ships in this environment (zero egress); ``prospect_data``'s anchors
+  transcribe the published curves, and swapping in an exact table is a
+  constant swap that touches no model code.
 """
 
 from __future__ import annotations
@@ -62,41 +64,19 @@ from .protocol import ObservationModel
 _EPS = 1e-6
 
 # ---------------------------------------------------------------------------
-# Per-band constants (10 bands: B02 B03 B04 B05 B06 B07 B08 B8A B09 B12).
+# Per-band constants (10 bands: B02 B03 B04 B05 B06 B07 B08 B8A B09 B12),
+# generated in ``obsops.prospect_data`` from published fine-grid spectra
+# (refractive index curve, liquid-water absorption, pigment band
+# decompositions, dry-matter SWIR rise) band-averaged over Gaussian
+# approximations of the Sentinel-2A spectral response functions.
 # ---------------------------------------------------------------------------
 
-#: Band centre wavelengths (nm), the reference band map order
-#: (``Sentinel2_Observations.py:93-94``).
-BAND_WAVELENGTHS = np.array(
-    [490.0, 560.0, 665.0, 705.0, 740.0, 783.0, 842.0, 865.0, 945.0, 2190.0]
-)
-
-#: Leaf refractive index per band (PROSPECT's n(lambda), band-averaged).
-N_REFRACT = np.array(
-    [1.53, 1.52, 1.50, 1.49, 1.48, 1.47, 1.46, 1.46, 1.45, 1.40]
-)
-
-#: Band-effective specific absorption per constituent:
-#: rows = (cab [ug/cm2]^-1, car [ug/cm2]^-1, cbrown [-], cw [cm]^-1,
-#: cm [g/cm2]^-1).  Shapes follow PROSPECT-5: chlorophyll in blue/red with
-#: the red-edge shoulder, carotenoids in blue only, brown pigment decaying
-#: from blue, water and dry matter in the SWIR.
-BAND_K = np.array([
-    # B02    B03    B04    B05    B06    B07    B08    B8A    B09    B12
-    [0.045, 0.018, 0.062, 0.012, 0.003, 0.000, 0.000, 0.000, 0.000, 0.000],
-    [0.060, 0.008, 0.000, 0.000, 0.000, 0.000, 0.000, 0.000, 0.000, 0.000],
-    [0.900, 0.450, 0.180, 0.100, 0.060, 0.040, 0.020, 0.015, 0.008, 0.000],
-    [0.000, 0.000, 0.000, 0.001, 0.002, 0.003, 0.005, 0.008, 0.450, 24.00],
-    [0.000, 0.000, 0.000, 0.000, 0.300, 0.500, 0.900, 1.000, 2.200, 28.00],
-])
-
-#: Typical dry/wet soil reflectance spectra at the 10 bands (linear mixing
-#: weighted by psoil, scaled by bsoil — the PROSAIL soil model).
-SOIL_DRY = np.array(
-    [0.12, 0.15, 0.19, 0.22, 0.24, 0.26, 0.28, 0.29, 0.31, 0.38]
-)
-SOIL_WET = np.array(
-    [0.06, 0.08, 0.10, 0.12, 0.13, 0.14, 0.15, 0.16, 0.17, 0.15]
+from .prospect_data import (  # noqa: E402  (constants, not code)
+    BAND_K,
+    BAND_WAVELENGTHS,
+    N_REFRACT,
+    SOIL_DRY,
+    SOIL_WET,
 )
 
 

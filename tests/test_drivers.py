@@ -428,8 +428,11 @@ class TestOomRecovery:
         assert os.path.exists(keep)
         # full chunk retried in a fresh process first, then 4 quarters
         assert sub_calls[0] == ("0001", 128, 100)
+        # dash-separated quarter prefixes: a bare 'a' suffix would be
+        # ambiguous with hex chunk ids (the 'keep' file above IS chunk
+        # '0001a''s output and must survive the cleanup globs)
         assert sorted(c[0] for c in sub_calls[1:]) == [
-            "0001a", "0001b", "0001c", "0001d"
+            "0001-a", "0001-b", "0001-c", "0001-d"
         ]
         assert all(c[1] <= 64 and c[2] <= 64 for c in sub_calls[1:])
         assert s["oom_split"] and s["n_pixels"] == 128 * 100

@@ -40,7 +40,8 @@ def tip_truth(mask, seed=3):
 
 def run_pipeline(scan_window, n_days=9, grid_step=1, checkpointer=None,
                  state_propagation=propagate_information_filter,
-                 prior=None, mask=None):
+                 prior=None, mask=None, checkpoint_every_n=1,
+                 solver_options=None):
     mask = pivot_mask() if mask is None else mask
     op = TwoStreamOperator()
     truth = tip_truth(mask)
@@ -63,7 +64,8 @@ def run_pipeline(scan_window, n_days=9, grid_step=1, checkpointer=None,
         prior=prior,
         pad_multiple=128,
         scan_window=scan_window,
-        solver_options={"relaxation": 0.7},
+        solver_options={"relaxation": 0.7, **(solver_options or {})},
+        checkpoint_every_n=checkpoint_every_n,
     )
     kf.set_trajectory_model()
     kf.set_trajectory_uncertainty(np.full(7, 1e-3, np.float32))
@@ -176,3 +178,70 @@ class TestGeoTIFFBlockDump:
             a, _ = read_geotiff(str(f))
             b, _ = read_geotiff(str(tmp_path / "blk" / f.name))
             np.testing.assert_array_equal(a, b, err_msg=f.name)
+
+
+class TestCheckpointCadence:
+    def test_every_n_reduces_saves_and_last_always_saved(self, tmp_path):
+        from kafka_tpu.engine import Checkpointer
+
+        ck1 = Checkpointer(str(tmp_path / "every1"))
+        kf1, *_ = run_pipeline(scan_window=1, checkpointer=ck1)
+        saved1 = [ts for ts, _ in ck1.list_checkpoints()]
+
+        ck3 = Checkpointer(str(tmp_path / "every3"))
+        kf3, *_ = run_pipeline(
+            scan_window=1, checkpointer=ck3, checkpoint_every_n=3
+        )
+        saved3 = [ts for ts, _ in ck3.list_checkpoints()]
+
+        assert len(saved1) > len(saved3) >= 1
+        # The run's final window must always checkpoint, whatever the
+        # cadence, or resume could never complete a finished chunk.
+        assert max(saved3) == max(saved1)
+        # Cadence-3 saves every third processed window (plus the last).
+        assert len(saved3) == -(-len(saved1) // 3) or \
+            len(saved3) == len(saved1) // 3 + 1
+
+    def test_cadenced_resume_matches_full_run(self, tmp_path):
+        """Killing a cadenced run and resuming from its last checkpoint
+        must reproduce the uninterrupted run's final state."""
+        from kafka_tpu.engine import Checkpointer
+
+        ck = Checkpointer(str(tmp_path / "ck"))
+        kf_full, out_full, x_full, _, mask = run_pipeline(
+            scan_window=1, checkpointer=ck, checkpoint_every_n=4
+        )
+        # Fresh pipeline resuming from the saved state over the SAME grid.
+        ck2 = Checkpointer(str(tmp_path / "ck"))
+        grid = [day(i) for i in range(0, 10)]
+        rest, seed = ck2.resume_time_grid(grid)
+        assert seed is not None
+        # The last checkpoint was the final window -> nothing left to do.
+        assert len(rest) == 1
+        np.testing.assert_allclose(
+            np.asarray(seed[0]), x_full, atol=1e-6
+        )
+
+
+class TestFusedConvergedMask:
+    def test_converged_frac_reported_on_both_paths(self):
+        opts = {"per_pixel_convergence": True}
+        kf_f, out_f, x_f, _, mask = run_pipeline(
+            scan_window=4, solver_options=opts
+        )
+        kf_u, out_u, x_u, _, _ = run_pipeline(
+            scan_window=1, solver_options=opts, mask=mask
+        )
+        fused_recs = [r for r in kf_f.diagnostics_log if r.get("fused")]
+        assert fused_recs, "expected fused windows"
+        for rec in fused_recs:
+            assert 0.0 <= rec["converged_frac"] <= 1.0
+        # The damped TIP problem converges essentially everywhere.
+        assert fused_recs[-1]["converged_frac"] > 0.95
+        unfused_recs = [
+            r for r in kf_u.diagnostics_log if not r.get("fused")
+        ]
+        assert unfused_recs and all(
+            "converged_frac" in r for r in unfused_recs
+        )
+        np.testing.assert_allclose(x_f, x_u, atol=2e-3)

@@ -1,0 +1,185 @@
+"""gp_emulator pickle ingestion: the reference's emulator artifacts must
+convert into GPParams without the gp_emulator package installed, with the
+converted predictive mean matching the original formulation exactly.
+"""
+
+import pickle
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from kafka_tpu.obsops import GPBankOperator
+from kafka_tpu.obsops.gp import gp_predict_pixel
+from kafka_tpu.obsops.gp_import import (
+    geometry_from_filename,
+    gp_params_from_emulator,
+    load_emulator_bank_file,
+    load_emulator_directory,
+    load_emulator_pickle,
+)
+
+RNG = np.random.default_rng(17)
+
+
+def _reference_predict(inputs, invQt, theta, x_star):
+    """The gp_emulator predictive mean, re-derived: a @ invQt with
+    a_j = e^{theta[D]} exp(-0.5 sum_d e^{theta[d]} (x*_d - X_jd)^2)."""
+    d = inputs.shape[1]
+    w = np.exp(theta[:d])
+    diff = inputs - x_star
+    a = np.exp(theta[d]) * np.exp(-0.5 * (w * diff**2).sum(axis=1))
+    return float(a @ invQt)
+
+
+def _fake_module():
+    """ONE fake gp_emulator module/class pair for the whole test run —
+    pickling by reference requires every instance to share the class
+    object registered in sys.modules at dump time."""
+    if not hasattr(_fake_module, "_mod"):
+        mod = types.ModuleType("gp_emulator")
+
+        class GaussianProcess:
+            pass
+
+        GaussianProcess.__module__ = "gp_emulator"
+        GaussianProcess.__qualname__ = "GaussianProcess"
+        mod.GaussianProcess = GaussianProcess
+        _fake_module._mod = mod
+    return _fake_module._mod
+
+
+def _make_fake_gp(m=40, d=4, seed=0, with_invqt=True):
+    """An object pickled AS a gp_emulator.GaussianProcess: the class is
+    registered under a fake gp_emulator module for pickling, then the
+    module is removed so loading must work without it."""
+    rng = np.random.default_rng(seed)
+    inputs = rng.uniform(0.0, 1.0, (m, d)).astype(np.float64)
+    targets = np.sin(inputs.sum(axis=1)) + 0.05 * rng.standard_normal(m)
+    # theta = [log inverse-sq lengthscales..., log amp, log noise]
+    theta = np.concatenate([
+        np.log(rng.uniform(2.0, 20.0, d)),
+        [np.log(1.3)], [np.log(1e-4)],
+    ])
+    w = np.exp(theta[:d])
+    z = inputs * np.sqrt(w)
+    d2 = (z * z).sum(1)[:, None] + (z * z).sum(1)[None, :] - 2 * z @ z.T
+    k = np.exp(theta[d]) * np.exp(-0.5 * np.maximum(d2, 0.0))
+    k[np.diag_indices_from(k)] += np.exp(theta[d + 1])
+    invQt = np.linalg.solve(k, targets)
+
+    mod = _fake_module()
+    gp = mod.GaussianProcess()
+    gp.inputs = inputs
+    gp.targets = targets
+    gp.theta = theta
+    if with_invqt:
+        gp.invQt = invQt
+    return gp, mod, (inputs, invQt, theta)
+
+
+def _pickle_without_module(obj, mod, path):
+    sys.modules["gp_emulator"] = mod
+    try:
+        with open(path, "wb") as f:
+            pickle.dump(obj, f, protocol=2)
+    finally:
+        del sys.modules["gp_emulator"]
+    assert "gp_emulator" not in sys.modules
+
+
+class TestEmulatorConversion:
+    def test_predictive_mean_matches_reference_formula(self, tmp_path):
+        gp, mod, (inputs, invQt, theta) = _make_fake_gp()
+        path = str(tmp_path / "emu.pkl")
+        _pickle_without_module(gp, mod, path)
+
+        loaded = load_emulator_pickle(path)
+        params = gp_params_from_emulator(loaded)
+        for i in range(5):
+            x_star = RNG.uniform(0.0, 1.0, inputs.shape[1]).astype(
+                np.float32
+            )
+            ours = float(gp_predict_pixel(params, x_star))
+            ref = _reference_predict(inputs, invQt, theta, x_star)
+            np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+    def test_missing_invqt_recomputed(self, tmp_path):
+        gp, mod, (inputs, invQt, theta) = _make_fake_gp(with_invqt=False)
+        path = str(tmp_path / "emu.pkl")
+        _pickle_without_module(gp, mod, path)
+        params = gp_params_from_emulator(load_emulator_pickle(path))
+        x_star = RNG.uniform(0.0, 1.0, inputs.shape[1]).astype(np.float32)
+        np.testing.assert_allclose(
+            float(gp_predict_pixel(params, x_star)),
+            _reference_predict(inputs, invQt, theta, x_star),
+            rtol=1e-3, atol=1e-3,
+        )
+
+    def test_band_dict_to_bank_and_operator(self, tmp_path):
+        """The reference's artifact shape: dict keyed b'S2A_MSI_NN', one
+        GP per band, differing inducing-set sizes — must stack into a
+        GPBankOperator aux whose forward matches each band's GP."""
+        bank = {}
+        originals = {}
+        mod = None
+        band_numbers = (2, 3, 4, 5, 6, 7, 8, 9, 12, 13)
+        for i, num in enumerate(band_numbers):
+            gp, mod, arrs = _make_fake_gp(m=30 + 3 * i, seed=num)
+            bank[b"S2A_MSI_%02d" % num] = gp
+            originals[num] = arrs
+        path = str(tmp_path / "prosail_5_30_90.pkl")
+        _pickle_without_module(bank, mod, path)
+
+        stacked = load_emulator_bank_file(path)
+        assert stacked.x_train.shape[0] == len(band_numbers)
+        op = GPBankOperator(n_params=4, n_bands=len(band_numbers))
+        x_star = RNG.uniform(0.2, 0.8, 4).astype(np.float32)
+        got = np.asarray(op.forward_pixel(stacked, x_star))
+        want = np.array([
+            _reference_predict(*originals[num], x_star)
+            for num in band_numbers
+        ])
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+    def test_incomplete_band_dict_raises(self, tmp_path):
+        gp, mod, _ = _make_fake_gp()
+        path = str(tmp_path / "emu_5_30_90.pkl")
+        _pickle_without_module({b"S2A_MSI_02": gp}, mod, path)
+        with pytest.raises(KeyError, match="band"):
+            load_emulator_bank_file(path)
+
+    def test_geometry_filename_parse(self):
+        # reference convention: ..._{vza}_{sza}_{raa}.pkl
+        # (vza third-from-last, sza second, raa last)
+        sza, vza, raa = geometry_from_filename(
+            "/x/prosail_S2A_10_30_120.pkl"
+        )
+        assert (sza, vza, raa) == (30.0, 10.0, 120.0)
+        with pytest.raises(ValueError):
+            geometry_from_filename("/x/no_geometry_here.pkl")
+
+    def test_directory_to_geometry_banks(self, tmp_path):
+        gp, mod, _ = _make_fake_gp()
+        band_numbers = (2, 3)
+        for vza, sza, raa in ((0, 20, 50), (10, 40, 120)):
+            bank = {}
+            for num in band_numbers:
+                g, mod, _ = _make_fake_gp(m=20, seed=num)
+                bank[b"S2A_MSI_%02d" % num] = g
+            _pickle_without_module(
+                bank, mod,
+                str(tmp_path / f"prosail_{vza}_{sza}_{raa}.pkl"),
+            )
+        banks = load_emulator_directory(
+            str(tmp_path), band_numbers=band_numbers
+        )
+        assert set(banks) == {(20.0, 0.0, 50.0), (40.0, 10.0, 120.0)}
+        # drops into the S2 geometry selection unchanged
+        from kafka_tpu.io.sentinel2 import geometry_bank_aux_builder
+
+        build = geometry_bank_aux_builder(banks)
+        meta = {"sza": 38.0, "vza": 11.0, "saa": 10.0, "vaa": 128.0}
+        aux = build(meta, None)
+        assert aux.x_train.shape[0] == len(band_numbers)

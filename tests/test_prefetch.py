@@ -114,7 +114,81 @@ class TestPrefetcher:
         pf = ObservationPrefetcher(src, gather, dates, depth=2)
         pf.get(dates[0])
         pf.close()  # must not hang on the full queue
-        assert not pf._thread.is_alive()
+        assert not any(t.is_alive() for t in pf._threads)
+
+
+class TestMultiWorkerPrefetch:
+    def test_ordered_delivery_with_racing_workers(self):
+        """Reads completing out of order (random per-date delays across 3
+        workers) must still deliver strictly in date order."""
+        rng = np.random.default_rng(0)
+        dates = [day(i) for i in range(12)]
+        delays = {d: float(rng.uniform(0.0, 0.03)) for d in dates}
+
+        class JitterSource(RecordingSource):
+            def get_observations(self, date, gather):
+                time.sleep(delays[date])
+                return super().get_observations(date, gather)
+
+        src = JitterSource(dates)
+        gather = make_pixel_gather(np.ones((2, 2), bool), pad_multiple=16)
+        pf = ObservationPrefetcher(src, gather, dates, depth=4, workers=3)
+        try:
+            for d in dates:
+                tag, got, _ = pf.get(d)
+                assert got == d
+        finally:
+            pf.close()
+
+    def test_workers_actually_overlap(self):
+        """With 3 workers and slow reads, several reads must be in flight
+        concurrently (wall time well under the serial sum)."""
+        dates = [day(i) for i in range(6)]
+        src = RecordingSource(dates, delay=0.1)
+        gather = make_pixel_gather(np.ones((2, 2), bool), pad_multiple=16)
+        t0 = time.monotonic()
+        pf = ObservationPrefetcher(src, gather, dates, depth=6, workers=3)
+        try:
+            for d in dates:
+                pf.get(d)
+        finally:
+            pf.close()
+        wall = time.monotonic() - t0
+        assert wall < 0.45, wall  # serial would be >= 0.6
+
+    def test_error_reraises_at_position_with_workers(self):
+        dates = [day(i) for i in range(6)]
+        src = RecordingSource(dates, fail_on=day(3))
+        gather = make_pixel_gather(np.ones((2, 2), bool), pad_multiple=16)
+        pf = ObservationPrefetcher(src, gather, dates, depth=3, workers=3)
+        try:
+            for d in dates[:3]:
+                pf.get(d)
+            with pytest.raises(IOError, match="synthetic read failure"):
+                pf.get(day(3))
+        finally:
+            pf.close()
+
+    def test_transform_applied_on_worker(self):
+        dates = [day(i) for i in range(4)]
+        src = RecordingSource(dates)
+        gather = make_pixel_gather(np.ones((2, 2), bool), pad_multiple=16)
+        seen_threads = set()
+
+        def tag(obs):
+            seen_threads.add(threading.current_thread().name)
+            return obs + ("transformed",)
+
+        pf = ObservationPrefetcher(
+            src, gather, dates, depth=2, workers=2, transform=tag
+        )
+        try:
+            for d in dates:
+                item = pf.get(d)
+                assert item[-1] == "transformed"
+        finally:
+            pf.close()
+        assert all(n.startswith("obs-prefetch") for n in seen_threads)
 
 
 class TestFilterIntegration:

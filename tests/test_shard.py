@@ -131,7 +131,7 @@ def test_fused_scan_composes_with_sharding(eight_cpu_devices):
     )
 
     # single device
-    _, _, xs_ref, diag_ref, iters_ref, _ = assimilate_windows_scan(
+    _, _, xs_ref, diag_ref, iters_ref, _, _ = assimilate_windows_scan(
         op.linearize, stacked, x0, pi0, None, m, q, None, None,
         propagate_information_filter, dict(opts), None,
     )
@@ -144,7 +144,7 @@ def test_fused_scan_composes_with_sharding(eight_cpu_devices):
         mask=jax.device_put(stacked.mask, band_sh),
     )
     xs0, ps0 = shard_state(mesh, x0, pi0)
-    x_fin, p_fin, xs_sh, diag_sh, iters_sh, _ = assimilate_windows_scan(
+    x_fin, p_fin, xs_sh, diag_sh, iters_sh, _, _ = assimilate_windows_scan(
         op.linearize, stacked_sh, xs0, ps0, None, m, q, None, None,
         propagate_information_filter, dict(opts), None,
     )
