@@ -266,6 +266,24 @@ def _decode_segments(segments, info, seg_shape):
     rows, cols = seg_shape
     itemsize = info.dtype.itemsize
     expected = rows * cols * info.n_bands * itemsize
+    if (
+        info.predictor == 3 and itemsize == 4
+        and info.compression in (1, 8, 32946)
+    ):
+        # Fused native chain: inflate + fpAcc + byte unshuffle in one
+        # parallel C++ pass over all tiles (the per-tile numpy
+        # accumulate/transpose below is the decode hot path at
+        # tile-year scale).  The byte-plane layout is endian-neutral,
+        # matching the numpy path exactly.
+        decoded = native_codec.decode_fp3_many(
+            segments, rows, cols, info.n_bands,
+            compressed=info.compression != 1,
+        )
+        if decoded is not None:
+            return [
+                decoded[i].astype(info.dtype, copy=False)
+                for i in range(len(segments))
+            ]
     present = [(i, s) for i, s in enumerate(segments) if len(s)]
     if info.compression in (8, 32946):
         raw_present = native_codec.inflate_many(
@@ -566,13 +584,18 @@ class TiledTiffWriter:
         self._pos = self._f.tell()
         self._closed = False
 
-    def _prep_tile(self, tile: np.ndarray) -> bytes:
-        """Pad to the tile grid + apply the predictor; returns raw bytes."""
+    def _pad_tile(self, tile: np.ndarray) -> np.ndarray:
+        """Pad a (possibly clipped edge) tile to the full tile grid."""
         arr = np.asarray(tile)
         if arr.ndim == 2:
             arr = arr[:, :, None]
         full = np.zeros((self.ts, self.ts, self.nb), self.dtype)
         full[:arr.shape[0], :arr.shape[1]] = arr.astype(self.dtype)
+        return full
+
+    def _prep_tile(self, tile: np.ndarray) -> bytes:
+        """Pad to the tile grid + apply the predictor; returns raw bytes."""
+        full = self._pad_tile(tile)
         if self.predictor == 3:
             return _fp_predict_encode(full)
         if self.predictor == 2:
@@ -619,16 +642,30 @@ class TiledTiffWriter:
         arr = np.asarray(rows)
         if arr.ndim == 2:
             arr = arr[:, :, None]
-        indices, raws = [], []
+        indices, tiles = [], []
         for dy in range(0, arr.shape[0], self.ts):
             for tx in range(self.tiles_across):
                 x0 = tx * self.ts
                 indices.append((ty0 + dy // self.ts) * self.tiles_across + tx)
-                raws.append(
-                    self._prep_tile(arr[dy:dy + self.ts, x0:x0 + self.ts])
-                )
-        segs = (native_codec.deflate_many(raws, self.level)
-                if self.compress else raws)
+                tiles.append(arr[dy:dy + self.ts, x0:x0 + self.ts])
+        if not tiles:
+            return
+        segs = None
+        if self.compress and self.predictor == 3 \
+                and native_codec.has_fp3():
+            # Fused native chain: fpDiff + deflate in one parallel C++
+            # pass over the whole tile band.  Capability is probed BEFORE
+            # building the padded stack so fallback systems don't pay for
+            # an allocation the native call would just discard.
+            stacked = np.stack([
+                self._pad_tile(t).astype(np.float32, copy=False)
+                for t in tiles
+            ])
+            segs = native_codec.encode_fp3_many(stacked, self.level)
+        if segs is None:
+            raws = [self._prep_tile(t) for t in tiles]
+            segs = (native_codec.deflate_many(raws, self.level)
+                    if self.compress else raws)
         for idx, seg in zip(indices, segs):
             self._append_segment(idx, seg)
 

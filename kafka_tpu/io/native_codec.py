@@ -42,3 +42,31 @@ def deflate_many(segments: Sequence[bytes], level: int = 6) -> List[bytes]:
     if lib:
         return lib.deflate_many(segments, level)
     return [zlib.compress(s, level) for s in segments]
+
+
+def has_fp3() -> bool:
+    """Whether the fused native predictor-3 chain is available (library
+    built AND carrying the round-3 entry points)."""
+    lib = _load_native()
+    return bool(lib) and getattr(lib, "has_fp3", False)
+
+
+def decode_fp3_many(segments: Sequence[bytes], rows: int, cols: int,
+                    nb: int, compressed: bool):
+    """Fused float32 predictor-3 decode (inflate + fpAcc + unshuffle) on
+    the native pool; returns a (n, rows, cols, nb) float32 array, or
+    None when the native library (with fp3 support) is unavailable —
+    callers fall back to the numpy predictor path."""
+    lib = _load_native()
+    if lib and getattr(lib, "has_fp3", False):
+        return lib.decode_fp3_many(segments, rows, cols, nb, compressed)
+    return None
+
+
+def encode_fp3_many(tiles, level: int = 1):
+    """Fused float32 predictor-3 encode (fpDiff + deflate); None when
+    native fp3 is unavailable."""
+    lib = _load_native()
+    if lib and getattr(lib, "has_fp3", False):
+        return lib.encode_fp3_many(tiles, level)
+    return None

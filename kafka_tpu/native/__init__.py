@@ -52,11 +52,99 @@ class RasterKit:
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int, u8p,
             ctypes.c_int64, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
         ]
+        # fp3 entry points are round-3 additions: a stale pre-built .so
+        # may lack them — degrade to the numpy predictor path, don't die.
+        self.has_fp3 = hasattr(lib, "rk_decode_fp3_batch")
+        if not self.has_fp3:
+            return
+        f32p = ctypes.POINTER(ctypes.c_float)
+        lib.rk_decode_fp3_batch.restype = ctypes.c_int
+        lib.rk_decode_fp3_batch.argtypes = [
+            ctypes.c_int64, ctypes.POINTER(u8p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, f32p, ctypes.c_int64,
+            ctypes.c_int,
+        ]
+        lib.rk_encode_fp3_batch.restype = ctypes.c_int
+        lib.rk_encode_fp3_batch.argtypes = [
+            ctypes.c_int64, f32p, ctypes.c_int64, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ]
+
+    def decode_fp3_many(self, segments: Sequence[bytes], rows: int,
+                        cols: int, nb: int, compressed: bool,
+                        n_threads: int = _DEFAULT_THREADS):
+        """Fused float32 predictor-3 tile decode: (optional) inflate +
+        fpAcc + byte unshuffle per tile, parallel over tiles.  Empty
+        segments decode to zero tiles.  Returns a (n, rows, cols, nb)
+        float32 array."""
+        import numpy as np
+
+        n = len(segments)
+        out = np.zeros((n, rows, cols, nb), np.float32)
+        if n == 0:
+            return out
+        n, bufs, ptrs, sizes = self._in_arrays(segments,
+                                               allow_empty=True)
+        stride = rows * cols * nb
+        rc = self._lib.rk_decode_fp3_batch(
+            n, ptrs, sizes, rows, cols, nb, int(bool(compressed)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            stride, n_threads,
+        )
+        if rc != 0:
+            raise ValueError(
+                "fp3 tile decode failed with zlib code %d" % rc
+            )
+        return out
+
+    def encode_fp3_many(self, tiles, level: int = 1,
+                        n_threads: int = _DEFAULT_THREADS) -> List[bytes]:
+        """Fused float32 predictor-3 tile encode: fpDiff + deflate per
+        tile, parallel over tiles.  ``tiles`` is a contiguous
+        (n, rows, cols, nb) float32 array; returns the n compressed
+        segments."""
+        import numpy as np
+
+        tiles = np.ascontiguousarray(tiles, np.float32)
+        n, rows, cols, nb = tiles.shape
+        if n == 0:
+            return []
+        rawbytes = rows * cols * nb * 4
+        stride = rawbytes + rawbytes // 1000 + 64
+        out = ctypes.create_string_buffer(n * stride)
+        out_sizes = (ctypes.c_int64 * n)()
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        rc = self._lib.rk_encode_fp3_batch(
+            n, tiles.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            rows * cols * nb, rows, cols, nb, int(level),
+            ctypes.cast(out, u8p), stride, out_sizes, n_threads,
+        )
+        if rc != 0:
+            raise ValueError(
+                "fp3 tile encode failed with zlib code %d" % rc
+            )
+        raw = out.raw
+        return [
+            raw[i * stride: i * stride + out_sizes[i]] for i in range(n)
+        ]
 
     @staticmethod
-    def _in_arrays(segments: Sequence[bytes]):
+    def _in_arrays(segments: Sequence[bytes], allow_empty: bool = False):
         n = len(segments)
-        bufs = [ctypes.create_string_buffer(s, len(s)) for s in segments]
+        if allow_empty:
+            # create_string_buffer needs size >= 1; empty segments are
+            # signalled by size 0 and never dereferenced natively.
+            bufs = [
+                ctypes.create_string_buffer(s if s else b"\x00",
+                                            max(len(s), 1))
+                for s in segments
+            ]
+        else:
+            bufs = [
+                ctypes.create_string_buffer(s, len(s)) for s in segments
+            ]
         u8p = ctypes.POINTER(ctypes.c_uint8)
         ptrs = (u8p * n)(
             *[ctypes.cast(b, u8p) for b in bufs]

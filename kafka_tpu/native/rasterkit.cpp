@@ -49,9 +49,127 @@ void parallel_for(int64_t n, int n_threads, Fn fn) {
   for (auto& w : workers) w.join();
 }
 
+// TIFF predictor-3 inverse (libtiff fpAcc): per row, byte-wise prefix sum
+// with stride nb over the 4 byte-significance planes (MSB plane first),
+// then unshuffle planes back into little-endian float32 samples.
+void fp3_accumulate(const uint8_t* raw, int rows, int cols, int nb,
+                    float* out, std::vector<uint8_t>& scratch) {
+  const int cn = cols * nb;
+  const int rowbytes = 4 * cn;
+  scratch.resize(rowbytes);
+  for (int r = 0; r < rows; ++r) {
+    const uint8_t* src = raw + static_cast<size_t>(r) * rowbytes;
+    uint8_t* acc = scratch.data();
+    std::memcpy(acc, src, rowbytes);
+    for (int i = nb; i < rowbytes; ++i)
+      acc[i] = static_cast<uint8_t>(acc[i] + acc[i - nb]);
+    uint8_t* o = reinterpret_cast<uint8_t*>(out
+                                            + static_cast<size_t>(r) * cn);
+    const uint8_t* p0 = acc;            // MSB plane
+    const uint8_t* p1 = acc + cn;
+    const uint8_t* p2 = acc + 2 * cn;
+    const uint8_t* p3 = acc + 3 * cn;   // LSB plane
+    for (int j = 0; j < cn; ++j) {
+      o[4 * j + 0] = p3[j];
+      o[4 * j + 1] = p2[j];
+      o[4 * j + 2] = p1[j];
+      o[4 * j + 3] = p0[j];
+    }
+  }
+}
+
+// TIFF predictor-3 forward (libtiff fpDiff): shuffle float32 samples into
+// byte-significance planes (MSB first) per row, then byte-wise
+// horizontal differencing with stride nb.
+void fp3_difference(const float* in, int rows, int cols, int nb,
+                    uint8_t* out) {
+  const int cn = cols * nb;
+  const int rowbytes = 4 * cn;
+  for (int r = 0; r < rows; ++r) {
+    const uint8_t* s = reinterpret_cast<const uint8_t*>(
+        in + static_cast<size_t>(r) * cn);
+    uint8_t* dst = out + static_cast<size_t>(r) * rowbytes;
+    uint8_t* p0 = dst;
+    uint8_t* p1 = dst + cn;
+    uint8_t* p2 = dst + 2 * cn;
+    uint8_t* p3 = dst + 3 * cn;
+    for (int j = 0; j < cn; ++j) {
+      p0[j] = s[4 * j + 3];
+      p1[j] = s[4 * j + 2];
+      p2[j] = s[4 * j + 1];
+      p3[j] = s[4 * j + 0];
+    }
+    for (int i = rowbytes - 1; i >= nb; --i)
+      dst[i] = static_cast<uint8_t>(dst[i] - dst[i - nb]);
+  }
+}
+
 }  // namespace
 
 extern "C" {
+
+// Fused tile decode for float32 predictor-3 tiles: (optional) zlib
+// inflate + fpAcc + byte unshuffle, one parallel pass over n tiles.
+// in_sizes[i] == 0 means a sparse/absent tile -> zero-filled output.
+// Short payloads are zero-padded (the Python codec's ljust contract).
+int rk_decode_fp3_batch(int64_t n, const uint8_t** in_ptrs,
+                        const int64_t* in_sizes, int rows, int cols,
+                        int nb, int compressed, float* out,
+                        int64_t out_stride_floats, int n_threads) {
+  std::atomic<int> status(0);
+  const size_t rawbytes = static_cast<size_t>(rows) * 4 * cols * nb;
+  parallel_for(n, n_threads, [&](int64_t i) {
+    float* dst = out + i * out_stride_floats;
+    if (in_sizes[i] == 0) {
+      std::memset(dst, 0, rawbytes);
+      return;
+    }
+    std::vector<uint8_t> raw(rawbytes, 0);
+    if (compressed) {
+      uLongf dest_len = static_cast<uLongf>(rawbytes);
+      int rc = uncompress(raw.data(), &dest_len, in_ptrs[i],
+                          static_cast<uLong>(in_sizes[i]));
+      if (rc != Z_OK) {
+        status.store(rc);
+        std::memset(dst, 0, rawbytes);
+        return;
+      }
+    } else {
+      std::memcpy(raw.data(), in_ptrs[i],
+                  std::min(rawbytes, static_cast<size_t>(in_sizes[i])));
+    }
+    std::vector<uint8_t> scratch;
+    fp3_accumulate(raw.data(), rows, cols, nb, dst, scratch);
+  });
+  return status.load();
+}
+
+// Fused tile encode: fpDiff + zlib deflate, one parallel pass.  Input is
+// n contiguous float32 tiles at in_stride_floats; output slot i is
+// out_buf + i*out_stride with capacity out_stride, byte counts in
+// out_sizes.
+int rk_encode_fp3_batch(int64_t n, const float* in,
+                        int64_t in_stride_floats, int rows, int cols,
+                        int nb, int level, uint8_t* out_buf,
+                        int64_t out_stride, int64_t* out_sizes,
+                        int n_threads) {
+  std::atomic<int> status(0);
+  const size_t rawbytes = static_cast<size_t>(rows) * 4 * cols * nb;
+  parallel_for(n, n_threads, [&](int64_t i) {
+    std::vector<uint8_t> raw(rawbytes);
+    fp3_difference(in + i * in_stride_floats, rows, cols, nb, raw.data());
+    uLongf dest_len = static_cast<uLongf>(out_stride);
+    int rc = compress2(out_buf + i * out_stride, &dest_len, raw.data(),
+                       static_cast<uLong>(rawbytes), level);
+    if (rc != Z_OK) {
+      status.store(rc);
+      out_sizes[i] = 0;
+    } else {
+      out_sizes[i] = static_cast<int64_t>(dest_len);
+    }
+  });
+  return status.load();
+}
 
 int rk_inflate_batch(int64_t n, const uint8_t** in_ptrs,
                      const int64_t* in_sizes, uint8_t* out_buf,
