@@ -176,6 +176,8 @@ class S1Observations:
         # One warp mapping per (source grid, dst shape) — shared by
         # VV/VH/theta of a scene (see sentinel2.py mapping cache).
         self._mapping_cache: Dict[tuple, tuple] = {}
+        # (mapping key, gather id) -> valid-pixel fractional coordinates.
+        self._gather_coord_cache: Dict[tuple, tuple] = {}
         # File-level ``enl`` attributes and per-scene auto estimates are
         # immutable: read/estimate once per path.
         self._enl_cache: Dict[Any, Optional[float]] = {}
@@ -183,10 +185,17 @@ class S1Observations:
     def define_output(self):
         return self.state_crs, list(self.state_geotransform)
 
-    def _warp_var(self, path: str, var: str, dst_shape,
-                  nodata: float) -> np.ndarray:
+    def _warp_var_gathered(self, path: str, var: str,
+                           gather: PixelGather, nodata: float
+                           ) -> np.ndarray:
+        """Warp one variable AT the valid pixels only, padded to
+        ``n_pad`` with ``nodata`` — skips the (1 - fill) fraction of the
+        chunk grid a full-grid warp would resample (see the S2 reader's
+        ``_gathered_coords``).  The coordinate cache holds the gather
+        object so its id cannot recycle while the entry lives."""
         arr, gt, crs = _read_nc_var(path, var)
         src_crs = crs if crs is not None else self.state_crs
+        dst_shape = gather.mask.shape
         key = (tuple(gt), src_crs, tuple(dst_shape))
         if key not in self._mapping_cache:
             self._mapping_cache[key] = grid_mapping(
@@ -194,7 +203,22 @@ class S1Observations:
                 src_crs=src_crs, dst_crs=self.state_crs,
             )
         col_f, row_f = self._mapping_cache[key]
-        return resample(arr, col_f, row_f, method="nearest", nodata=nodata)
+        gkey = (key, id(gather))
+        hit = self._gather_coord_cache.get(gkey)
+        if hit is None or hit[0] is not gather:
+            hit = (
+                gather,
+                col_f[gather.rows, gather.cols],
+                row_f[gather.rows, gather.cols],
+            )
+            self._gather_coord_cache[gkey] = hit
+        vals = resample(arr, hit[1], hit[2], method="nearest",
+                        nodata=nodata)
+        if vals.ndim > 1:
+            vals = vals[..., 0]
+        out = np.full(gather.n_pad, nodata, np.float32)
+        out[: gather.n_valid] = vals
+        return out
 
     def _file_enl(self, path: str) -> Optional[float]:
         if path in self._enl_cache:
@@ -231,17 +255,15 @@ class S1Observations:
 
     def get_observations(self, date, gather: PixelGather) -> DateObservation:
         path = self.date_data[date]
-        dst_shape = gather.mask.shape
         if self.enl == "auto":
             enl = self._auto_enl(path)
         else:
             enl = self.enl if self.enl is not None else self._file_enl(path)
         ys, r_invs, masks = [], [], []
         for pol in POLARISATIONS:
-            sigma0 = self._warp_var(
-                path, f"sigma0_{pol}", dst_shape, MISSING_VALUE
-            ).astype(np.float32)
-            pix = gather.gather(sigma0, fill=MISSING_VALUE)
+            pix = self._warp_var_gathered(
+                path, f"sigma0_{pol}", gather, MISSING_VALUE
+            )
             mask = (
                 (pix != MISSING_VALUE) & np.isfinite(pix) & gather.valid
             )
@@ -268,13 +290,12 @@ class S1Observations:
         # Per-pixel incidence angle if the file carries it; otherwise the
         # reference's hard-coded 23 degrees (sar_forward_model.py:156).
         try:
-            theta = self._warp_var(path, "theta", dst_shape, 23.0)
+            theta_pix = self._warp_var_gathered(path, "theta", gather, 23.0)
         except KeyError:
-            theta = np.full(dst_shape, 23.0, np.float32)
-        theta_pix = gather.gather(
-            np.where(np.isfinite(theta), theta, 23.0).astype(np.float32),
-            fill=23.0,
-        )
+            theta_pix = np.full(gather.n_pad, 23.0, np.float32)
+        theta_pix = np.where(
+            np.isfinite(theta_pix), theta_pix, 23.0
+        ).astype(np.float32)
         aux = WCMAux(theta_deg=jnp.asarray(theta_pix))
         bands = BandBatch(
             y=jnp.asarray(np.stack(ys)),

@@ -517,3 +517,44 @@ class TestS2BandPool:
             np.testing.assert_array_equal(
                 np.asarray(a.bands.mask), np.asarray(b.bands.mask)
             )
+
+
+class TestGatheredWarpCacheIsolation:
+    def test_one_reader_many_gathers(self, tmp_path):
+        """One reader serving DIFFERENT PixelGathers (the public API
+        allows it) must keep their cached warp coordinates isolated —
+        guards the id-keyed coordinate cache against collisions."""
+        import datetime as _dt
+
+        from kafka_tpu.testing.fixtures import (
+            DEFAULT_GEO, make_s2_granule_tree,
+        )
+
+        dates = [_dt.datetime(2017, 7, 1)]
+        make_s2_granule_tree(str(tmp_path / "s2"), dates, ny=30, nx=30,
+                             noise=0.01)
+        geo = (DEFAULT_GEO.geotransform, DEFAULT_GEO.epsg)
+        src = Sentinel2Observations(str(tmp_path / "s2"), None, geo,
+                                    band_workers=1)
+        m_a = np.zeros((30, 30), bool)
+        m_a[:10] = True
+        m_b = np.zeros((30, 30), bool)
+        m_b[20:] = True
+        g_a = make_pixel_gather(m_a, 64)
+        g_b = make_pixel_gather(m_b, 64)
+        o_a = src.get_observations(dates[0], g_a)
+        o_b = src.get_observations(dates[0], g_b)
+        o_a2 = src.get_observations(dates[0], g_a)
+        np.testing.assert_array_equal(
+            np.asarray(o_a.bands.y), np.asarray(o_a2.bands.y)
+        )
+        assert not np.allclose(
+            np.asarray(o_a.bands.y), np.asarray(o_b.bands.y)
+        )
+        # parity with a cold-cache reader for the second gather
+        fresh = Sentinel2Observations(str(tmp_path / "s2"), None, geo,
+                                      band_workers=1)
+        o_b2 = fresh.get_observations(dates[0], g_b)
+        np.testing.assert_array_equal(
+            np.asarray(o_b.bands.y), np.asarray(o_b2.bands.y)
+        )
