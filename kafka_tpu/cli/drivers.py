@@ -10,6 +10,7 @@ from ``kafka_tpu.shard.run_chunks`` (the dask-equivalent, restart-safe).
 
 from __future__ import annotations
 
+import functools
 import json
 import logging
 import os
@@ -202,12 +203,69 @@ def split_chunk(chunk) -> list:
     return subs
 
 
+@functools.lru_cache(maxsize=4)
+def _emulator_banks(folder: str):
+    """Converted per-geometry emulator banks, loaded once per process
+    (every chunk shares them; the jitted program is keyed on the
+    operator, only the bank arrays change per date).
+
+    When ``folder`` holds raw pickles, the converted banks are written
+    to a ``.kafka_tpu_banks/`` cache next to them (best-effort): fresh
+    worker processes — every chunk after a device OOM runs in one —
+    then load the .npz cache instead of re-paying the full unpickle +
+    per-band alpha recompute per process."""
+    import glob as _glob
+
+    from ..obsops.gp_import import (
+        load_emulator_directory, save_bank_npz,
+    )
+
+    cache = os.path.join(folder, ".kafka_tpu_banks")
+    if _glob.glob(os.path.join(cache, "*.npz")):
+        return load_emulator_directory(cache)
+    banks = load_emulator_directory(folder)
+    had_pickles = bool(_glob.glob(os.path.join(folder, "*.pkl")))
+    if had_pickles and not _glob.glob(os.path.join(folder, "*.npz")):
+        try:
+            os.makedirs(cache, exist_ok=True)
+            for (sza, vza, raa), bank in banks.items():
+                save_bank_npz(
+                    os.path.join(
+                        cache, f"bank_{vza:g}_{sza:g}_{raa:g}.npz"
+                    ),
+                    bank,
+                )
+            LOG.info("cached %d converted emulator bank(s) in %s",
+                     len(banks), cache)
+        except OSError as exc:
+            LOG.warning("could not cache converted banks in %s: %s",
+                        cache, exc)
+    return banks
+
+
+@functools.lru_cache(maxsize=4)
+def _gp_bank_builder(folder: str) -> Callable:
+    from ..io.sentinel2 import geometry_bank_aux_builder
+
+    return geometry_bank_aux_builder(_emulator_banks(folder))
+
+
+def gp_bank_aux_builder(cfg: RunConfig) -> Callable:
+    """Per-date geometry -> converted emulator bank (the reference's
+    per-geometry unpickling, ``Sentinel2_Observations.py:157-159``).
+    Cached per folder so repeated resolution returns the SAME callable —
+    the OOM-recovery identity check relies on it."""
+    return _gp_bank_builder(cfg.extra["emulator_folder"])
+
+
 #: aux builders reconstructible by name in a fresh worker process.
 def resolve_aux_builder(cfg: RunConfig) -> Optional[Callable]:
     # The joint S2+S1 configuration feeds the same scene-angle builder to
     # its Sentinel-2 side (run_joint.py).
     if cfg.operator in ("prosail", "prosail_joint"):
         return prosail_aux_builder
+    if cfg.operator == "gp_bank":
+        return gp_bank_aux_builder(cfg)
     return None
 
 

@@ -1,0 +1,74 @@
+"""Convert the reference's gp_emulator pickle artifacts into .npz banks.
+
+Reference users carry directories of per-geometry emulator pickles
+(``prosail_..._{vza}_{sza}_{raa}.pkl`` — dicts of per-band
+``gp_emulator.GaussianProcess`` objects,
+``/root/reference/kafka/input_output/Sentinel2_Observations.py:133-159``).
+This tool converts them once into plain ``.npz`` banks (stacked
+``GPParams``, no foreign classes, instant loads); ``kafka-tpu-s2
+--emulators <folder>`` then runs the S2 assimilation through those
+emulators exactly as the reference would — no PROSAIL physics operator
+involved.
+
+Usage:
+    kafka-tpu-import-emulators /path/emulator_pickles /path/banks_out
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import logging
+import os
+
+from . import make_console
+
+LOG = logging.getLogger(__name__)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("src", help="directory of gp_emulator pickles")
+    ap.add_argument("dst", help="output directory for .npz banks")
+    ap.add_argument("--pattern", default="*.pkl")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING
+    )
+    from ..obsops.gp_import import (
+        geometry_from_filename,
+        load_emulator_bank_file,
+        save_bank_npz,
+    )
+
+    os.makedirs(args.dst, exist_ok=True)
+    n_done = 0
+    for path in sorted(
+        glob.glob(os.path.join(args.src, args.pattern))
+    ):
+        try:
+            sza, vza, raa = geometry_from_filename(path)
+        except ValueError:
+            LOG.warning("skipping %s: no _vza_sza_raa geometry in name",
+                        path)
+            continue
+        bank = load_emulator_bank_file(path)
+        base = os.path.splitext(os.path.basename(path))[0]
+        out = os.path.join(args.dst, f"{base}.npz")
+        save_bank_npz(out, bank)
+        LOG.info("%s -> %s (sza=%g vza=%g raa=%g)", path, out, sza, vza,
+                 raa)
+        n_done += 1
+    if n_done == 0:
+        raise SystemExit(
+            f"no emulator pickles matching {args.pattern} in {args.src}"
+        )
+    print(f"converted {n_done} emulator bank(s) into {args.dst}")
+    return 0
+
+
+console = make_console(main)
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -22,7 +22,7 @@ import logging
 from ..engine.config import RunConfig
 from ..engine.priors import PROSAIL_PARAMETER_LIST
 from . import make_console
-from .drivers import prosail_aux_builder, run_config
+from .drivers import resolve_aux_builder, run_config
 
 
 def default_config() -> RunConfig:
@@ -49,6 +49,12 @@ def main(argv=None):
     ap.add_argument("--data-folder", default=None)
     ap.add_argument("--state-mask", default=None)
     ap.add_argument("--outdir", default=None)
+    ap.add_argument("--emulators", default=None,
+                    help="directory of gp_emulator pickles or converted "
+                         ".npz banks (kafka-tpu-import-emulators): runs "
+                         "the assimilation through the reference's "
+                         "emulator artifacts instead of the built-in "
+                         "PROSAIL physics operator")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     logging.basicConfig(
@@ -62,8 +68,11 @@ def main(argv=None):
         cfg.state_mask = args.state_mask
     if args.outdir:
         cfg.output_folder = args.outdir
+    if args.emulators:
+        cfg.operator = "gp_bank"
+        cfg.extra["emulator_folder"] = args.emulators
 
-    stats = run_config(cfg, aux_builder=prosail_aux_builder)
+    stats = run_config(cfg, aux_builder=resolve_aux_builder(cfg))
     print(json.dumps(stats))
     return stats
 

@@ -52,7 +52,21 @@ def _operator_registry() -> Dict[str, Callable]:
         "kernels": lambda cfg: _make_kernels(cfg),
         "prosail_joint": lambda cfg: _joint_op("ProsailJointOperator"),
         "wcm_joint": lambda cfg: _joint_op("WCMJointOperator"),
+        # Converted gp_emulator banks (the reference's actual emulator
+        # artifacts) as the S2 operator: per-date geometry selects a
+        # bank through the aux builder, extra["emulator_folder"] points
+        # at the pickles/.npz files.
+        "gp_bank": lambda cfg: _make_gp_bank(cfg),
     }
+
+
+def _make_gp_bank(cfg):
+    from ..obsops.gp import GPBankOperator
+
+    return GPBankOperator(
+        n_params=cfg.n_params,
+        n_bands=int(cfg.extra.get("gp_n_bands", 10)),
+    )
 
 
 def _joint_op(name):

@@ -159,6 +159,17 @@ class GPBankOperator(ObservationModel):
         )
 
     def forward_pixel(self, aux: GPParams, x_pixel):
+        # Shapes are static under trace: a bank whose band axis disagrees
+        # with the operator must fail loudly here — JAX clamps
+        # out-of-bounds indices, so leaf[b] past the end would silently
+        # repeat the last band's prediction instead of erroring.
+        n_in_bank = int(aux.x_train.shape[0])
+        if n_in_bank != self.n_bands:
+            raise ValueError(
+                f"emulator bank carries {n_in_bank} band(s) but the "
+                f"operator expects {self.n_bands}"
+            )
+
         def one_band(b):
             params = jax.tree.map(lambda leaf: leaf[b], aux)
             sub = x_pixel if self.mappers is None else x_pixel[self.mappers[b]]

@@ -215,27 +215,72 @@ def geometry_from_filename(path: str) -> Tuple[float, float, float]:
     )
 
 
+def save_bank_npz(path: str, params: GPParams) -> None:
+    """Persist a stacked per-geometry bank as a plain ``.npz`` — the
+    reproducible artifact replacing the reference's opaque pickles
+    (loads ~instantly, no unpickling of foreign classes)."""
+    np.savez(
+        path,
+        **{f: np.asarray(getattr(params, f)) for f in GPParams._fields},
+    )
+
+
+def load_bank_npz(path: str) -> GPParams:
+    import jax.numpy as jnp
+
+    data = np.load(path)
+    return GPParams(
+        **{f: jnp.asarray(data[f]) for f in GPParams._fields}
+    )
+
+
 def load_emulator_directory(
     folder: str,
     pattern: str = "*.pkl",
     band_numbers: Tuple[int, ...] = EMULATOR_BAND_MAP,
 ) -> Dict[Tuple[float, float, float], GPParams]:
-    """A directory of per-geometry pickles -> the ``banks`` dict of
-    ``io.sentinel2.geometry_bank_aux_builder``: each date's scene angles
-    then select the nearest converted bank, exactly like the reference's
-    per-geometry unpickling — but as traced arrays through one compiled
-    program."""
+    """A directory of per-geometry emulator files -> the ``banks`` dict
+    of ``io.sentinel2.geometry_bank_aux_builder``: each date's scene
+    angles then select the nearest converted bank, exactly like the
+    reference's per-geometry unpickling — but as traced arrays through
+    one compiled program.
+
+    Accepts the reference's pickles AND this package's converted
+    ``.npz`` banks; when both carry the same geometry the ``.npz`` wins
+    (it IS the converted pickle, and loads without the per-band
+    unpickle/recompute cost)."""
     banks: Dict[Tuple[float, float, float], GPParams] = {}
-    for path in sorted(glob.glob(os.path.join(folder, pattern))):
+    pkl_paths = sorted(
+        p for p in glob.glob(os.path.join(folder, pattern))
+        if not p.endswith(".npz")
+    )
+    npz_paths = sorted(glob.glob(os.path.join(folder, "*.npz")))
+    npz_keys = set()
+    for path in npz_paths:
         try:
             key = geometry_from_filename(path)
         except ValueError:
             LOG.warning("skipping %s: no geometry in filename", path)
+            continue
+        banks[key] = load_bank_npz(path)
+        npz_keys.add(key)
+        LOG.info("loaded emulator bank %s -> geometry %s", path, key)
+    for path in pkl_paths:
+        try:
+            key = geometry_from_filename(path)
+        except ValueError:
+            LOG.warning("skipping %s: no geometry in filename", path)
+            continue
+        if key in npz_keys:
+            LOG.debug("%s: geometry %s already loaded from .npz", path,
+                      key)
             continue
         banks[key] = load_emulator_bank_file(
             path, band_numbers=band_numbers
         )
         LOG.info("converted emulator bank %s -> geometry %s", path, key)
     if not banks:
-        raise IOError(f"no emulator pickles matching {pattern} in {folder}")
+        raise IOError(
+            f"no emulator files ({pattern} or *.npz) in {folder}"
+        )
     return banks

@@ -1,0 +1,160 @@
+"""End-to-end reference-artifact path: gp_emulator pickles -> converted
+banks -> the S2 driver assimilating through them (operator "gp_bank").
+
+This is the drop-in story for reference users: their per-geometry
+emulator pickles drive the TPU engine with no PROSAIL physics operator
+involved.
+"""
+
+import datetime
+import os
+import pickle
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from kafka_tpu.engine.config import RunConfig
+from kafka_tpu.engine.priors import PROSAIL_PARAMETER_LIST
+
+BAND_NUMBERS = (2, 3, 4, 5, 6, 7, 8, 9, 12, 13)
+
+
+def _fake_module():
+    if not hasattr(_fake_module, "_mod"):
+        mod = types.ModuleType("gp_emulator")
+
+        class GaussianProcess:
+            pass
+
+        GaussianProcess.__module__ = "gp_emulator"
+        GaussianProcess.__qualname__ = "GaussianProcess"
+        mod.GaussianProcess = GaussianProcess
+        _fake_module._mod = mod
+    return _fake_module._mod
+
+
+def _make_emulator_pickle(path, aux, n_train=200, seed=0):
+    """Fit one GP per band to the PROSAIL forward at this geometry and
+    pickle them in the reference's artifact format."""
+    import jax
+
+    from kafka_tpu.engine.priors import sail_prior
+    from kafka_tpu.obsops.prosail import ProsailOperator
+
+    op = ProsailOperator()
+    rng = np.random.default_rng(seed)
+    prior = sail_prior()
+    mean = np.asarray(prior.prior.mean)
+    lo, hi = op.state_bounds
+    x_train = np.clip(
+        mean + rng.normal(0, 0.08, (n_train, 10)), lo + 1e-3, hi - 1e-3
+    ).astype(np.float32)
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        y = np.asarray(op.forward(aux, jax.device_put(x_train, cpu)))
+
+    mod = _fake_module()
+    bank = {}
+    for b, num in enumerate(BAND_NUMBERS):
+        # gp_emulator hyperparameters: theta = [log w_d..., log amp,
+        # log noise], w = inverse squared lengthscales.
+        ell = x_train.std(0).astype(np.float64) * 2.0 + 0.05
+        theta = np.concatenate([
+            np.log(1.0 / ell**2), [np.log(0.05)], [np.log(1e-6)],
+        ])
+        w = np.exp(theta[:10])
+        z = x_train.astype(np.float64) * np.sqrt(w)
+        d2 = (
+            (z * z).sum(1)[:, None] + (z * z).sum(1)[None, :]
+            - 2.0 * z @ z.T
+        )
+        k = np.exp(theta[10]) * np.exp(-0.5 * np.maximum(d2, 0.0))
+        k[np.diag_indices_from(k)] += np.exp(theta[11])
+        gp = mod.GaussianProcess()
+        gp.inputs = x_train.astype(np.float64)
+        gp.targets = y[b].astype(np.float64)
+        gp.theta = theta
+        gp.invQt = np.linalg.solve(k, y[b].astype(np.float64))
+        bank[b"S2A_MSI_%02d" % num] = gp
+    sys.modules["gp_emulator"] = mod
+    try:
+        with open(path, "wb") as f:
+            pickle.dump(bank, f, protocol=2)
+    finally:
+        del sys.modules["gp_emulator"]
+
+
+@pytest.mark.slow
+def test_s2_run_through_converted_reference_emulators(tmp_path):
+    from kafka_tpu.cli.drivers import resolve_aux_builder, run_one_chunk
+    from kafka_tpu.cli.import_emulators import main as import_main
+    from kafka_tpu.io.geotiff import read_geotiff
+    from kafka_tpu.io.tiling import Chunk
+    from kafka_tpu.obsops.prosail import ProsailAux
+    from kafka_tpu.testing.fixtures import (
+        DEFAULT_GEO, make_pivot_mask, make_s2_granule_tree,
+    )
+    import jax.numpy as jnp
+
+    ny = nx = 24
+    dates = [datetime.datetime(2017, 7, 3),
+             datetime.datetime(2017, 7, 5)]
+    make_s2_granule_tree(str(tmp_path / "s2"), dates, ny=ny, nx=nx)
+
+    # Emulator pickles at the scene geometry (sza 30.5, vza 5, raa -50
+    # -> filename-encoded grid point).
+    aux = ProsailAux(
+        sza=jnp.asarray(30.5), vza=jnp.asarray(5.0),
+        raa=jnp.asarray(-50.0),
+    )
+    os.makedirs(tmp_path / "pickles")
+    _make_emulator_pickle(
+        str(tmp_path / "pickles" / "prosail_5_30_310.pkl"), aux
+    )
+    # CLI conversion to .npz banks
+    import_main([str(tmp_path / "pickles"), str(tmp_path / "banks"),
+                 "--verbose"])
+    assert list((tmp_path / "banks").glob("*.npz"))
+
+    # The S2 driver path with operator gp_bank over the converted banks.
+    from kafka_tpu.io.geotiff import GeoInfo, write_geotiff
+
+    mask = make_pivot_mask(ny, nx, n_pivots=2, seed=1)
+    write_geotiff(str(tmp_path / "mask.tif"),
+                  mask.astype(np.uint8), DEFAULT_GEO)
+    cfg = RunConfig(
+        parameter_list=PROSAIL_PARAMETER_LIST,
+        start=dates[0] - datetime.timedelta(days=1),
+        end=dates[-1] + datetime.timedelta(days=1),
+        step_days=2,
+        operator="gp_bank",
+        propagator="none",
+        prior="sail",
+        observations="sentinel2",
+        data_folder=str(tmp_path / "s2"),
+        state_mask=str(tmp_path / "mask.tif"),
+        output_folder=str(tmp_path / "out"),
+        chunk_size=(64, 64),
+        solver_options={"relaxation": 0.6},
+        device_mesh="none",
+    )
+    cfg.extra["emulator_folder"] = str(tmp_path / "banks")
+    from kafka_tpu.io.geotiff import read_info
+
+    _, info = read_geotiff(str(tmp_path / "mask.tif"))
+    chunk = Chunk(0, 0, nx, ny, 0)
+    summary = run_one_chunk(
+        cfg, chunk, "0000", mask, info.geo,
+        aux_builder=resolve_aux_builder(cfg),
+    )
+    assert summary is not None and summary["n_dates_assimilated"] == 2
+    outs = sorted((tmp_path / "out").glob("lai_*.tif"))
+    assert outs
+    lai, _ = read_geotiff(str(outs[-1]))
+    assert np.isfinite(lai).all()
+    vals = lai[mask.astype(bool)]
+    # The synthetic truth has TLAI ~ exp(-lai/2) around the SAIL prior;
+    # emulated retrievals must land in (0, 1) and actually move pixels.
+    assert ((vals > 0.0) & (vals < 1.0)).all()

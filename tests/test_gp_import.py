@@ -183,3 +183,79 @@ class TestEmulatorConversion:
         meta = {"sza": 38.0, "vza": 11.0, "saa": 10.0, "vaa": 128.0}
         aux = build(meta, None)
         assert aux.x_train.shape[0] == len(band_numbers)
+
+
+class TestBankPrecedenceAndValidation:
+    def test_npz_wins_over_pickle_for_same_geometry(self, tmp_path):
+        import jax.numpy as jnp
+
+        from kafka_tpu.obsops.gp import GPParams
+        from kafka_tpu.obsops.gp_import import (
+            load_emulator_directory, save_bank_npz,
+        )
+
+        gp, mod, _ = _make_fake_gp(m=12)
+        _pickle_without_module(
+            {b"S2A_MSI_02": gp},
+            mod, str(tmp_path / "bank_5_30_90.pkl"),
+        )
+        marker = GPParams(
+            x_train=jnp.zeros((1, 7, 4)), alpha=jnp.ones((1, 7)),
+            log_lengthscales=jnp.zeros((1, 4)),
+            log_amplitude=jnp.zeros((1,)), y_mean=jnp.full((1,), 42.0),
+        )
+        save_bank_npz(str(tmp_path / "bank_5_30_90.npz"), marker)
+        banks = load_emulator_directory(str(tmp_path),
+                                        band_numbers=(2,))
+        assert float(banks[(30.0, 5.0, 90.0)].y_mean[0]) == 42.0
+
+    def test_bank_band_mismatch_raises_not_clamps(self):
+        import jax.numpy as jnp
+
+        from kafka_tpu.obsops.gp import GPBankOperator, GPParams
+
+        bank = GPParams(
+            x_train=jnp.zeros((3, 6, 4)), alpha=jnp.zeros((3, 6)),
+            log_lengthscales=jnp.zeros((3, 4)),
+            log_amplitude=jnp.zeros((3,)), y_mean=jnp.zeros((3,)),
+        )
+        op = GPBankOperator(n_params=4, n_bands=10)
+        with pytest.raises(ValueError, match="3 band"):
+            op.forward_pixel(bank, jnp.zeros(4))
+
+    def test_driver_cache_written_once(self, tmp_path):
+        from kafka_tpu.cli import drivers
+
+        gp, mod, _ = _make_fake_gp(m=10)
+        _pickle_without_module(
+            {b"S2A_MSI_%02d" % n: gp for n in (2, 3)},
+            mod, str(tmp_path / "bank_0_20_50.pkl"),
+        )
+        drivers._emulator_banks.cache_clear()
+        import kafka_tpu.obsops.gp_import as gpi
+
+        orig = gpi.load_emulator_bank_file
+        calls = []
+
+        def counting(path, **kw):
+            calls.append(path)
+            return orig(path, band_numbers=(2, 3))
+
+        gpi.load_emulator_bank_file = counting
+        try:
+            banks1 = drivers._emulator_banks(str(tmp_path))
+            assert len(calls) == 1
+            assert (tmp_path / ".kafka_tpu_banks").is_dir()
+            # A FRESH process (simulated via cache_clear) loads the npz
+            # cache, not the pickle.
+            drivers._emulator_banks.cache_clear()
+            banks2 = drivers._emulator_banks(str(tmp_path))
+            assert len(calls) == 1  # pickle not touched again
+        finally:
+            gpi.load_emulator_bank_file = orig
+            drivers._emulator_banks.cache_clear()
+        np.testing.assert_allclose(
+            np.asarray(banks1[(20.0, 0.0, 50.0)].alpha),
+            np.asarray(banks2[(20.0, 0.0, 50.0)].alpha),
+            atol=1e-7,
+        )
