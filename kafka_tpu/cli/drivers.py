@@ -134,6 +134,7 @@ def run_one_chunk(
         scan_window=cfg.scan_window,
         mesh=make_run_mesh(cfg),
         checkpoint_every_n=cfg.checkpoint_every_n,
+        band_sequential=cfg.band_sequential,
     )
     kf.set_trajectory_model()
     q = cfg.q_diag if cfg.q_diag is not None else np.zeros(cfg.n_params)

@@ -374,6 +374,24 @@ def iterated_solve(
         a = a - hessian_correction(
             fwd_pixel, x, obs.r_inv, innovations, obs.mask
         )
+        # The second-order term is subtracted UNGUARDED in the reference
+        # (``linear_kf.py:412-416``); where the linearisation is poor it
+        # can push A off the positive-definite cone, and the next date's
+        # Cholesky then emits NaN for that pixel forever.  Clamp the
+        # per-pixel eigenvalues to a small positive floor — a no-op for
+        # healthy pixels, a finite (near-zero-information) matrix for
+        # the pathological ones.
+        w, v = jnp.linalg.eigh(a)
+        floor = 1e-6 * jnp.maximum(jnp.abs(w[..., -1:]), 1e-3)
+        fixed = jnp.einsum(
+            "nij,nj,nkj->nik", v, jnp.maximum(w, floor), v,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        # Healthy pixels keep their EXACT matrix (the eigh round-trip
+        # would otherwise smear ~1e-7 reconstruction error over every
+        # pixel); only off-cone pixels take the clamped rebuild.
+        bad = w[..., 0] < floor[..., 0]
+        a = jnp.where(bad[:, None, None], fixed, a)
     diags = SolveDiagnostics(
         innovations=innovations,
         fwd_modelled=fwd,

@@ -172,6 +172,11 @@ class RunConfig:
     #: temporal fusion: consecutive single-observation windows run as one
     #: lax.scan program in blocks of up to this many; 1 disables
     scan_window: int = 8
+    #: the reference's legacy band-SEQUENTIAL assimilation
+    #: (``linear_kf.py:325-425``: per-band Gauss-Newton, posterior ->
+    #: next band's prior) instead of the joint multiband update its
+    #: shipped drivers use; disables temporal fusion
+    band_sequential: bool = False
     solver_options: Optional[dict] = None
     #: folder for per-timestep state checkpoints (packed-triangle .npz,
     #: prefixed per chunk).  A restarted run resumes each unfinished chunk

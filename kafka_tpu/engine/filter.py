@@ -69,6 +69,7 @@ class KalmanFilter:
         mesh=None,
         mesh_lane: int = 128,
         checkpoint_every_n: int = 1,
+        band_sequential: bool = False,
     ):
         self.observations = observations
         self.output = output
@@ -116,6 +117,17 @@ class KalmanFilter:
         # Observations fetched while probing a fusion block but consumed
         # by the unfused path instead (prefetcher dates pop exactly once).
         self._pending_obs: dict = {}
+        # The reference's LEGACY band-sequential path
+        # (``linear_kf.py:325-425``): each band assimilates alone, its
+        # posterior becoming the next band's prior, with its own
+        # Gauss-Newton loop (and per-band Hessian correction when on).
+        # The default joint multiband update matches the reference's
+        # shipped drivers (``assimilate_multiple_bands``); this mode
+        # reproduces the older sequential conditioning — identical for
+        # linear operators, order-dependent for nonlinear ones, exactly
+        # as in the reference.
+        self.band_sequential = bool(band_sequential)
+        self._band_views: dict = {}
         # Checkpoint cadence: save at most every N grid windows (the last
         # window of a run always saves).  1 = the reference-faithful
         # every-window cadence; at annual-chain scale that is ~50
@@ -285,13 +297,20 @@ class KalmanFilter:
             # blocking, ~1.4M px exhausts a 16 GB chip).
             if self.gather.n_pad > 262144:
                 opts.setdefault("linearize_block", 262144)
-            hess_fwd = None
-            if self.hessian_correction:
-                hess_fwd = getattr(obs.operator, "forward_pixel", None)
-            x_a, p_inv_a, diags = assimilate_date_jit(
-                obs.operator.linearize, obs.bands, x_a,
-                p_inv_a, obs.aux, opts or None, hess_fwd,
-            )
+            if self.band_sequential:
+                x_a, p_inv_a, diags = self._assimilate_band_sequential(
+                    obs, x_a, p_inv_a, opts
+                )
+            else:
+                hess_fwd = None
+                if self.hessian_correction:
+                    hess_fwd = getattr(
+                        obs.operator, "forward_pixel", None
+                    )
+                x_a, p_inv_a, diags = assimilate_date_jit(
+                    obs.operator.linearize, obs.bands, x_a,
+                    p_inv_a, obs.aux, opts or None, hess_fwd,
+                )
             p_a = None
             if self.diagnostics:
                 # One packed read: each device->host round-trip costs
@@ -322,6 +341,67 @@ class KalmanFilter:
                     rec["wall_s"],
                 )
         return x_a, p_a, p_inv_a
+
+    def _band_view(self, operator, band: int):
+        from ..obsops.protocol import BandView, ObservationModel
+
+        # Fail HERE with a clear message, not with an opaque
+        # NotImplementedError from inside a vmap trace: the sequential
+        # mode slices the operator's forward_pixel per band, so a
+        # linearize-only operator (plain-closure form) cannot use it.
+        fwd = getattr(type(operator), "forward_pixel", None)
+        if fwd is None or fwd is ObservationModel.forward_pixel:
+            raise TypeError(
+                "band_sequential=True requires the operator to "
+                "implement forward_pixel; "
+                f"{type(operator).__name__} only provides linearize"
+            )
+        key = (id(operator), band)
+        view = self._band_views.get(key)
+        if view is None or view.inner is not operator:
+            view = self._band_views[key] = BandView(operator, band)
+        return view
+
+    def _assimilate_band_sequential(self, obs, x_a, p_inv_a, opts):
+        """One acquisition, bands assimilated SEQUENTIALLY — the
+        reference's ``assimilate``/``assimilate_band`` legacy semantics
+        (``linear_kf.py:325-425``): per band, a full Gauss-Newton loop,
+        posterior -> next band's prior, Hessian correction per band.
+
+        Merged diagnostics are conservative: iterations SUM over the
+        per-band loops, the convergence norm is the WORST band's (a date
+        only reads as converged when every band's loop converged), and
+        the per-pixel converged mask is the AND over bands."""
+        n_bands = obs.bands.y.shape[0]
+        iters_total = 0
+        norms = []
+        masks = []
+        last_diags = None
+        for b in range(n_bands):
+            band_obs = BandBatch(
+                y=obs.bands.y[b:b + 1],
+                r_inv=obs.bands.r_inv[b:b + 1],
+                mask=obs.bands.mask[b:b + 1],
+            )
+            view = self._band_view(obs.operator, b)
+            hess_fwd = view.forward_pixel if self.hessian_correction \
+                else None
+            x_a, p_inv_a, last_diags = assimilate_date_jit(
+                view.linearize, band_obs, x_a, p_inv_a, obs.aux,
+                opts or None, hess_fwd,
+            )
+            iters_total += last_diags.n_iterations
+            norms.append(last_diags.convergence_norm)
+            if last_diags.converged_mask is not None:
+                masks.append(last_diags.converged_mask)
+        diags = last_diags._replace(
+            n_iterations=iters_total,
+            convergence_norm=jnp.max(jnp.stack(norms)),
+            converged_mask=(
+                jnp.all(jnp.stack(masks), axis=0) if masks else None
+            ),
+        )
+        return x_a, p_inv_a, diags
 
     def run(self, time_grid, x_forecast, p_forecast, p_forecast_inverse,
             checkpointer=None, advance_first=False, profile_dir=None):
@@ -455,7 +535,7 @@ class KalmanFilter:
         """Engine-level fusability: a date-invariant (or absent) prior, and
         no opt-in Pallas kernel (structural option the scan path does not
         carry — silently dropping it would be worse than not fusing)."""
-        if self.scan_window <= 1:
+        if self.scan_window <= 1 or self.band_sequential:
             return False
         if (self.solver_options or {}).get("use_pallas"):
             return False

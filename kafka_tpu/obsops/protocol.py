@@ -104,6 +104,38 @@ class ObservationModel:
         )(aux, x)
 
 
+class BandView(ObservationModel):
+    """A single-band view of a multi-band operator — the unit of the
+    reference's legacy band-sequential assimilation
+    (``linear_kf.py:325-425``: each band's posterior becomes the next
+    band's prior).  A stable callable per (operator, band): the engine
+    caches views so each band's jitted program compiles once.
+
+    Known cost: the view evaluates the INNER operator's full multi-band
+    forward and slices one output, so monolithic spectral operators
+    (PROSAIL: one RT chain feeding all bands) pay ~n_bands of redundant
+    work per band — n_bands^2 total vs the joint update.  That is the
+    nature of the legacy mode (the reference's per-band loop re-ran its
+    emulators the same way); per-band-separable operators
+    (``MappedStateModel``) dead-code-eliminate cleanly."""
+
+    def __init__(self, inner: ObservationModel, band: int):
+        self.inner = inner
+        self.band = int(band)
+        self.n_bands = 1
+        self.n_params = inner.n_params
+        self.state_bounds = getattr(inner, "state_bounds", None)
+        self.aux_per_pixel = getattr(inner, "aux_per_pixel", True)
+
+    def forward_pixel(self, aux: Any, x_pixel: jnp.ndarray) -> jnp.ndarray:
+        return self.inner.forward_pixel(aux, x_pixel)[
+            self.band:self.band + 1
+        ]
+
+    def aux_in_axes(self, aux: Any, n_pix: int):
+        return self.inner.aux_in_axes(aux, n_pix)
+
+
 class MappedStateModel(ObservationModel):
     """Wraps a sub-state operator into the full state vector via per-band
     index mapping — the reference's ``state_mapper``/``band_selecta`` pattern
