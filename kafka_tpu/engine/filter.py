@@ -370,12 +370,16 @@ class KalmanFilter:
 
         Merged diagnostics are conservative: iterations SUM over the
         per-band loops, the convergence norm is the WORST band's (a date
-        only reads as converged when every band's loop converged), and
-        the per-pixel converged mask is the AND over bands."""
+        only reads as converged when every band's loop converged), the
+        per-pixel converged mask is the AND over bands, and the
+        innovations/forward-model residuals concatenate over bands so
+        the merged record covers every band like the joint path's."""
         n_bands = obs.bands.y.shape[0]
         iters_total = 0
         norms = []
         masks = []
+        innovations = []
+        fwds = []
         last_diags = None
         for b in range(n_bands):
             band_obs = BandBatch(
@@ -392,11 +396,15 @@ class KalmanFilter:
             )
             iters_total += last_diags.n_iterations
             norms.append(last_diags.convergence_norm)
+            innovations.append(last_diags.innovations)
+            fwds.append(last_diags.fwd_modelled)
             if last_diags.converged_mask is not None:
                 masks.append(last_diags.converged_mask)
         diags = last_diags._replace(
             n_iterations=iters_total,
             convergence_norm=jnp.max(jnp.stack(norms)),
+            innovations=jnp.concatenate(innovations, axis=0),
+            fwd_modelled=jnp.concatenate(fwds, axis=0),
             converged_mask=(
                 jnp.all(jnp.stack(masks), axis=0) if masks else None
             ),

@@ -37,7 +37,7 @@ from ..core.types import BandBatch
 from ..engine.protocols import DateObservation
 from ..engine.state import PixelGather
 from .geotiff import read_geotiff_window, read_info
-from .warp import grid_mapping, resample
+from .warp import grid_mapping
 
 LOG = logging.getLogger(__name__)
 
@@ -236,26 +236,33 @@ class Sentinel2Observations:
         )
         hit = self._gather_coord_cache.get(key)
         if hit is None or hit[0] is not gather:
-            hit = (
-                gather,
-                col_l[gather.rows, gather.cols],
-                row_l[gather.rows, gather.cols],
-            )
+            gcol = col_l[gather.rows, gather.cols]
+            grow = row_l[gather.rows, gather.cols]
+            # Precompute the nearest-neighbour integer lookup ONCE: all
+            # 10 bands of every date share these coordinates, and the
+            # per-band round/astype/bounds arithmetic was the warm read
+            # path's single largest cost (~0.3 s/date at 1.2M px).
+            ci = np.round(gcol).astype(np.int64)
+            ri = np.round(grow).astype(np.int64)
+            valid = (ci >= 0) & (ci < nc) & (ri >= 0) & (ri < nr)
+            np.clip(ci, 0, nc - 1, out=ci)
+            np.clip(ri, 0, nr - 1, out=ri)
+            hit = (gather, ri, ci, valid)
             self._gather_coord_cache[key] = hit
-        return hit[1], hit[2], r0, c0, nr, nc
+        return hit[1], hit[2], hit[3], r0, c0, nr, nc
 
     def _band_arrays(self, path: str, dst_shape, gather: PixelGather):
-        """One band's full host chain: read window -> decode -> warp AT
-        the valid pixels -> reflectance/uncertainty arrays."""
+        """One band's full host chain: read window -> decode -> nearest
+        lookup AT the valid pixels -> reflectance/uncertainty arrays."""
         info = self._band_info(path)
-        gcol, grow, r0, c0, nr, nc = self._gathered_coords(
+        ri, ci, in_bounds, r0, c0, nr, nc = self._gathered_coords(
             info, dst_shape, gather
         )
         win, _ = read_geotiff_window(path, r0, c0, nr, nc, info=info)
-        vals = resample(
-            win if win.ndim == 2 else win[..., 0],
-            gcol, grow, method="nearest", nodata=0.0,
-        ).astype(np.float32)
+        win2d = win if win.ndim == 2 else win[..., 0]
+        vals = win2d[ri, ci].astype(np.float32, copy=False)
+        if not in_bounds.all():
+            vals = np.where(in_bounds, vals, np.float32(0.0))
         rho_pix = np.zeros(gather.n_pad, np.float32)
         rho_pix[: gather.n_valid] = vals
         mask = (rho_pix > 0) & gather.valid
