@@ -38,7 +38,9 @@ def bench_device_sizes(sizes, ks=(5, 25)):
     ``(T(k2)-T(k1))/(k2-k1)`` is the true per-solve time, with flush and
     round-trip fixed costs cancelled.  This is also the honest model of
     production use: the engine queues per-date programs and syncs rarely.
-    Returns ``{n_pix: pixels_per_sec}``.
+    Returns ``{n_pix: (pixels_per_sec, median_ms_per_solve,
+    slope_spread_ms)}`` with the median pooled over every burst of that
+    size in ``sizes``.
     """
     import jax
     import jax.numpy as jnp
@@ -47,7 +49,12 @@ def bench_device_sizes(sizes, ks=(5, 25)):
     from kafka_tpu.testing.synthetic import make_tip_problem
 
     np.asarray(jax.jit(lambda v: v + 1)(jnp.zeros(8)))  # sync regime on
-    out = {}
+    slopes_by_size: dict = {}
+    k2_by_size: dict = {}
+    # Small batches are latency-dominated and the tunnel's per-dispatch
+    # overhead drifts at minute scale (observed 10x swings between
+    # invocations); repeated sizes in ``sizes`` therefore measure in
+    # SEPARATE bursts spread across the run and pool their slopes.
     for n_pix in sizes:
         op, bands, x0, p_inv0 = make_tip_problem(n_pix)
         opts = {"state_bounds": (
@@ -68,21 +75,34 @@ def bench_device_sizes(sizes, ks=(5, 25)):
         # the flush round-trip noise (~0.1 s on the tunnel): a fixed-size
         # pilot can't be trusted for sub-millisecond solves, where a few
         # solves' worth of work is buried in that noise.  Then median of
-        # 3 slope estimates.
+        # 5 slope estimates.  A later burst of the same size reuses the
+        # k2 its first burst discovered (still valid under drift — k2
+        # only ever needs to be LARGE enough) instead of re-paying the
+        # escalation's thousands of extra solves.
         k1, k2 = ks
-        while (run_k(k2) - run_k(k1)) < 1.5 and k2 < 8000:
-            k2 = min(k2 * 4, 8000)
-        slopes = sorted(
+        k2 = max(k2, k2_by_size.get(n_pix, k2))
+        if n_pix not in k2_by_size:
+            while (run_k(k2) - run_k(k1)) < 1.5 and k2 < 8000:
+                k2 = min(k2 * 4, 8000)
+            k2_by_size[n_pix] = k2
+        burst = [
             (run_k(k2) - run_k(k1)) / (k2 - k1) for _ in range(5)
-        )
-        dt = slopes[2]
+        ]
+        slopes_by_size.setdefault(n_pix, []).extend(burst)
+        dt = float(np.median(burst))
         print(
             f"device: {n_pix} px, {int(diags.n_iterations)} GN iters, "
             f"{dt*1e3:.2f} ms/solve sustained on "
             f"{jax.devices()[0].platform}",
             file=sys.stderr,
         )
-        out[n_pix] = n_pix / dt
+    out = {}
+    for n_pix, slopes in slopes_by_size.items():
+        dt = float(np.median(slopes))
+        out[n_pix] = (
+            n_pix / dt, dt * 1e3,
+            (max(slopes) - min(slopes)) * 1e3,
+        )
     return out
 
 
@@ -248,20 +268,35 @@ def main():
     n_matched = 16384
     n_device = 1 << 19
     base_px_s, oracle_ms, oracle_spread_ms = bench_oracle(n_matched)
-    dev = bench_device_sizes([n_matched, n_device])
-    dev_matched_px_s = dev[n_matched]
-    dev_px_s = dev[n_device]
+    # The matched size measures in two bursts bracketing the large-size
+    # run: the tunnel's per-dispatch overhead drifts at minute scale, and
+    # the pooled median (+ reported spread) bounds that drift's effect
+    # on the headline speedup.
+    dev = bench_device_sizes([n_matched, n_device, n_matched])
+    dev_matched_px_s, matched_ms, matched_spread_ms = dev[n_matched]
+    dev_px_s, _, _ = dev[n_device]
     e2e_px_steps_s, device_frac, e2e_pix = bench_end_to_end()
     print(json.dumps({
         "metric": "assimilation_throughput",
         "value": round(dev_px_s, 1),
         "unit": "pixels/sec",
         "vs_baseline": round(dev_matched_px_s / base_px_s, 2),
+        # The matched-size ratio above is honest but DOUBLY noisy: both
+        # the 16384-px device row (tunnel dispatch latency, drifts 4x at
+        # hour scale) and the CPU oracle (host load, 3x between rounds)
+        # wander; their spreads are reported.  The ratio of the two
+        # STABLE quantities — device throughput at its operating size
+        # (+-1% all day) over the oracle's per-pixel rate (size-linear
+        # for a block-diagonal solve) — is the comparable cross-round
+        # number.
+        "vs_baseline_at_scale": round(dev_px_s / base_px_s, 2),
         "oracle_ms_median": round(oracle_ms, 1),
         "oracle_ms_spread": round(oracle_spread_ms, 1),
         "n_pix_device": n_device,
         "n_pix_matched": n_matched,
         "device_px_s_matched": round(dev_matched_px_s, 1),
+        "device_ms_matched_median": round(matched_ms, 3),
+        "device_ms_matched_spread": round(matched_spread_ms, 3),
         "e2e_pixel_steps_per_s": round(e2e_px_steps_s, 1),
         "e2e_device_fraction": round(device_frac, 3),
         "e2e_n_pixels": e2e_pix,
