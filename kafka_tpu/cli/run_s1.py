@@ -46,6 +46,24 @@ def default_config() -> RunConfig:
     )
 
 
+def _enl_arg(text: str):
+    """'auto' or a positive look count — ENL <= 0 would silently
+    zero-weight every observation (sigma -> inf)."""
+    if text == "auto":
+        return "auto"
+    try:
+        value = float(text)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"--enl must be 'auto' or a number, got {text!r}"
+        )
+    if value <= 0:
+        raise argparse.ArgumentTypeError(
+            f"--enl must be positive, got {value}"
+        )
+    return value
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", default=None,
@@ -53,6 +71,15 @@ def main(argv=None):
     ap.add_argument("--data-folder", default=None, help="S1 NetCDF folder")
     ap.add_argument("--state-mask", default=None)
     ap.add_argument("--outdir", default=None)
+    ap.add_argument("--enl", default=None, type=_enl_arg,
+                    help="equivalent number of looks for speckle-"
+                         "statistics uncertainty: a number, 'auto' "
+                         "(estimate per scene from homogeneous-block "
+                         "statistics), or omit for the file attribute / "
+                         "5%% relative placeholder")
+    ap.add_argument("--noise-floor", type=float, default=None,
+                    help="noise-equivalent sigma0 (linear power) added "
+                         "in quadrature to the speckle term")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     logging.basicConfig(
@@ -66,6 +93,10 @@ def main(argv=None):
         cfg.state_mask = args.state_mask
     if args.outdir:
         cfg.output_folder = args.outdir
+    if args.enl is not None:
+        cfg.extra["s1_enl"] = args.enl
+    if args.noise_floor is not None:
+        cfg.extra["s1_noise_floor"] = args.noise_floor
 
     stats = run_config(cfg)
     print(json.dumps(stats))
