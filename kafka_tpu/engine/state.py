@@ -14,7 +14,6 @@ never scattered back.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
 
 import numpy as np
 

@@ -39,7 +39,7 @@ import numpy as np
 from ..core.types import BandBatch
 from ..engine.protocols import DateObservation
 from ..engine.state import PixelGather
-from .geotiff import read_geotiff, read_info
+from .geotiff import read_info
 from .roi import RoiWindowMixin, index_dated_paths
 
 LOG = logging.getLogger(__name__)

@@ -10,7 +10,7 @@ a chunk shard over the device mesh.
 
 from __future__ import annotations
 
-from typing import Iterator, List, NamedTuple, Tuple
+from typing import Iterator, NamedTuple, Tuple
 
 
 class Chunk(NamedTuple):

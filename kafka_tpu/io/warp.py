@@ -21,7 +21,7 @@ nearest-neighbour for masks and bilinear for reflectances
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Tuple
+from typing import Tuple
 
 import numpy as np
 

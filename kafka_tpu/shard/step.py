@@ -15,10 +15,10 @@ configuration, then feed it each date's band batch.
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 import jax
-import jax.numpy as jnp
+
 from jax.sharding import Mesh
 
 from ..core import propagators as prop

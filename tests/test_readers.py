@@ -558,3 +558,17 @@ class TestGatheredWarpCacheIsolation:
         np.testing.assert_array_equal(
             np.asarray(o_b.bands.y), np.asarray(o_b2.bands.y)
         )
+
+
+def test_estimate_enl_trailing_band_axis():
+    """A (ny, nx, 1) sigma0 layout (io.warp's trailing band axis) must
+    estimate like its 2-D squeeze; deeper stacks return None (fallback)."""
+    from kafka_tpu.io.sentinel1 import estimate_enl
+
+    rng = np.random.default_rng(8)
+    L = 6.0
+    arr2d = (0.1 * rng.gamma(L, 1.0 / L, (120, 120))).astype(np.float32)
+    est2d = estimate_enl(arr2d)
+    est3d = estimate_enl(arr2d[..., None])
+    assert est2d is not None and est3d == est2d
+    assert estimate_enl(np.zeros((4, 5, 6, 7), np.float32)) is None
