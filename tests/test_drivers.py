@@ -609,3 +609,31 @@ class TestDriverMeshMode:
                 np.asarray(b), np.asarray(a), atol=3e-4,
                 err_msg=os.path.basename(ref),
             )
+
+
+class TestMakeRunMesh:
+    def test_modes(self, eight_cpu_devices):
+        import jax
+
+        from kafka_tpu.cli.drivers import make_run_mesh
+        from kafka_tpu.engine.config import RunConfig
+
+        def cfg(mode):
+            return RunConfig(
+                parameter_list=("a",),
+                start=datetime.datetime(2020, 1, 1),
+                end=datetime.datetime(2020, 1, 2),
+                device_mesh=mode,
+            )
+
+        assert make_run_mesh(cfg("none")) is None
+        # conftest exposes 8 CPU devices -> auto and local build a mesh
+        # spanning ALL local devices (the documented contract)
+        n_local = len(jax.local_devices())
+        mesh_auto = make_run_mesh(cfg("auto"))
+        mesh_local = make_run_mesh(cfg("local"))
+        assert mesh_auto is not None and mesh_local is not None
+        assert mesh_auto.devices.size == n_local
+        assert mesh_local.devices.size == n_local
+        with pytest.raises(ValueError, match="device_mesh"):
+            make_run_mesh(cfg("nonne"))

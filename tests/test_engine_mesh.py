@@ -137,3 +137,16 @@ class TestEngineMeshParity:
         np.testing.assert_allclose(
             np.asarray(x_s)[: x_r.shape[0]], np.asarray(x_r), atol=2e-4
         )
+
+
+def test_single_device_mesh_works(eight_cpu_devices):
+    """device_mesh='local' forced on a one-chip host: a 1-device mesh
+    must run and match the no-mesh path exactly (guards the forced-local
+    configuration on single-chip machines)."""
+    mesh = make_pixel_mesh(eight_cpu_devices[:1])
+    kf_s, out_s, x_s, _ = run_tip_engine(mesh, 1, (1, 2), (0, 3))
+    kf_r, out_r, x_r, _ = run_tip_engine(None, 1, (1, 2), (0, 3))
+    assert kf_s.gather.n_pad == kf_r.gather.n_pad
+    np.testing.assert_allclose(
+        np.asarray(x_s), np.asarray(x_r), atol=1e-6
+    )
