@@ -11,11 +11,14 @@ directory, projection citation) plus GDAL-style nodata.
 
 Container parsing/assembly is pure Python + NumPy; the per-tile
 compress/decompress/predictor hot path is dispatched to the C++ codec in
-``kafka_tpu/native`` (thread-pooled zlib) when built, else Python zlib.
+``kafka_tpu/native`` (thread-pooled zlib, fused float32-predictor-3
+chain, batch LZW) when built, else Python zlib + the reference decoders
+here.
 
 Capabilities: float32/float64/uint8/int16/uint16/int32/uint32 samples,
-single- or multi-band (band-interleaved-by-pixel), compression none/deflate
-(8)/adobe-deflate(32946), predictor 1/2.
+single- or multi-band (band-interleaved-by-pixel), compression
+none/deflate(8)/adobe-deflate(32946)/LZW(5) read AND write (LZW write is
+the GDAL-default-compatibility mode), predictor 1/2/3.
 """
 
 from __future__ import annotations
@@ -292,7 +295,20 @@ def _decode_segments(segments, info, seg_shape):
     elif info.compression == 1:
         raw_present = [bytes(s) for _, s in present]
     elif info.compression == 5:
-        raw_present = [_lzw_decode(bytes(s)) for _, s in present]
+        raw_present = None
+        try:
+            raw_present = native_codec.lzw_inflate_many(
+                [s for _, s in present], expected
+            )
+        except ValueError:
+            # The native decoder hard-caps its output at expected+16;
+            # a stream with trailing post-EOI bytes (foreign encoders)
+            # can exceed it.  The Python reference decoder tolerates
+            # and truncates — fall through to it rather than failing
+            # the whole read.
+            raw_present = None
+        if raw_present is None:
+            raw_present = [_lzw_decode(bytes(s)) for _, s in present]
     else:
         raise NotImplementedError(
             "TIFF compression %d not supported" % info.compression
@@ -322,6 +338,61 @@ def _decode_segments(segments, info, seg_shape):
             np.cumsum(arr, axis=1, out=arr, dtype=arr.dtype)
         out.append(arr)
     return out
+
+
+def lzw_encode(data: bytes) -> bytes:
+    """TIFF LZW encode (MSB-first, early-change) — the inverse of
+    ``_lzw_decode``, used to build LZW fixtures without GDAL.  The
+    encoder's width switch runs one append later than the decoder's
+    (``next_code >= 1 << nbits``): the decoder's table lags the
+    encoder's by exactly one entry."""
+    out = bytearray()
+    bitbuf = bitcnt = 0
+    nbits = 9
+
+    def put(code):
+        nonlocal bitbuf, bitcnt
+        bitbuf = (bitbuf << nbits) | code
+        bitcnt += nbits
+        while bitcnt >= 8:
+            out.append((bitbuf >> (bitcnt - 8)) & 0xFF)
+            bitcnt -= 8
+
+    table = {bytes([i]): i for i in range(256)}
+    next_code = 258
+    put(256)
+    w = b""
+    for ch in data:
+        wc = w + bytes([ch])
+        if wc in table:
+            w = wc
+            continue
+        put(table[w])
+        table[wc] = next_code
+        next_code += 1
+        if next_code >= 4094:
+            put(256)
+            table = {bytes([i]): i for i in range(256)}
+            next_code = 258
+            nbits = 9
+        elif next_code >= (1 << nbits) and nbits < 12:
+            nbits += 1
+        w = bytes([ch])
+    if w:
+        put(table[w])
+        # The decoder appends its (lagged) table entry upon receiving
+        # this final code, closing the one-entry lag — so the EOI must
+        # be written at the width the decoder will READ it with
+        # (libtiff's LZWPostEncode does the same final bump).  Without
+        # this, streams whose final code lands the decoder's table
+        # exactly on a width boundary (511/1023/2047) decode with
+        # trailing garbage.
+        if next_code >= (1 << nbits) - 1 and nbits < 12:
+            nbits += 1
+    put(257)
+    if bitcnt:
+        out.append((bitbuf << (8 - bitcnt)) & 0xFF)
+    return bytes(out)
 
 
 def _lzw_decode(data: bytes) -> bytes:
@@ -540,7 +611,7 @@ class TiledTiffWriter:
         dtype=np.float32,
         geo: Optional[GeoInfo] = None,
         tile_size: int = 256,
-        compress: bool = True,
+        compress="deflate",  # True|"deflate" (fast, native) | "lzw" (interop) | False
         level: int = 6,
         predictor: int = 1,
         bigtiff: Optional[bool] = None,
@@ -563,7 +634,19 @@ class TiledTiffWriter:
             )
         self.geo = geo or GeoInfo()
         self.ts = int(tile_size)
-        self.compress = bool(compress)
+        # compress: True/"deflate" (the reference's KafkaOutput choice,
+        # the parallel native fast path), "lzw" (GDAL's default creation
+        # option — an INTEROP/FIXTURE mode: the pure-Python encoder is
+        # serial and slow, fine for masks/fixtures, wrong for tile-scale
+        # outputs), or False.
+        if compress == "lzw":
+            self.codec = "lzw"
+        elif compress in (True, "deflate"):
+            self.codec = "deflate"
+        elif not compress:
+            self.codec = None
+        else:
+            raise ValueError(f"compress={compress!r}")
         self.level = int(level)
         self.predictor = int(predictor)
         self.tiles_down = (self.h + self.ts - 1) // self.ts
@@ -626,7 +709,9 @@ class TiledTiffWriter:
         if not (0 <= ty < self.tiles_down and 0 <= tx < self.tiles_across):
             raise IndexError(f"tile ({ty}, {tx}) outside grid")
         seg = self._prep_tile(tile)
-        if self.compress:
+        if self.codec == "lzw":
+            seg = lzw_encode(seg)
+        elif self.codec == "deflate":
             seg = native_codec.deflate_many([seg], self.level)[0]
         self._append_segment(ty * self.tiles_across + tx, seg)
 
@@ -651,7 +736,7 @@ class TiledTiffWriter:
         if not tiles:
             return
         segs = None
-        if self.compress and self.predictor == 3 \
+        if self.codec == "deflate" and self.predictor == 3 \
                 and native_codec.has_fp3():
             # Fused native chain: fpDiff + deflate in one parallel C++
             # pass over the whole tile band.  Capability is probed BEFORE
@@ -664,8 +749,12 @@ class TiledTiffWriter:
             segs = native_codec.encode_fp3_many(stacked, self.level)
         if segs is None:
             raws = [self._prep_tile(t) for t in tiles]
-            segs = (native_codec.deflate_many(raws, self.level)
-                    if self.compress else raws)
+            if self.codec == "lzw":
+                segs = [lzw_encode(r) for r in raws]
+            elif self.codec == "deflate":
+                segs = native_codec.deflate_many(raws, self.level)
+            else:
+                segs = raws
         for idx, seg in zip(indices, segs):
             self._append_segment(idx, seg)
 
@@ -678,7 +767,8 @@ class TiledTiffWriter:
         entries = [
             (T_WIDTH, 3, (self.w,)), (T_HEIGHT, 3, (self.h,)),
             (T_BITS, 3, (bits,) * self.nb),
-            (T_COMPRESSION, 3, (8 if self.compress else 1,)),
+            (T_COMPRESSION, 3,
+             ({"deflate": 8, "lzw": 5, None: 1}[self.codec],)),
             (T_PHOTOMETRIC, 3, (1,)),
             (T_SAMPLES_PER_PIXEL, 3, (self.nb,)),
             (T_PLANAR, 3, (1,)),
@@ -747,7 +837,7 @@ def write_geotiff(
     array: np.ndarray,
     geo: Optional[GeoInfo] = None,
     tile_size: int = 256,
-    compress: bool = True,
+    compress="deflate",  # True|"deflate" (fast, native) | "lzw" (interop) | False
     level: int = 6,
     predictor: int = 1,
     bigtiff: Optional[bool] = None,
@@ -756,9 +846,11 @@ def write_geotiff(
     writer-side contract of the reference's ``KafkaOutput``
     (``observations.py:360-365``: COMPRESS=DEFLATE, TILED=YES, PREDICTOR=1,
     BIGTIFF=YES; BigTIFF here switches on automatically past 3.5 GB or can
-    be forced).  Streams through :class:`TiledTiffWriter` tile-row by
-    tile-row, so peak memory is one row of compressed tiles, not the whole
-    file."""
+    be forced).  ``compress="lzw"`` writes GDAL's default creation option
+    instead — an interop/fixture mode (serial Python encoder; keep the
+    DEFLATE fast path for tile-scale outputs).  Streams through
+    :class:`TiledTiffWriter` tile-row by tile-row, so peak memory is one
+    row of compressed tiles, not the whole file."""
     arr = np.asarray(array)
     if arr.ndim == 2:
         arr = arr[:, :, None]

@@ -44,6 +44,16 @@ def deflate_many(segments: Sequence[bytes], level: int = 6) -> List[bytes]:
     return [zlib.compress(s, level) for s in segments]
 
 
+def lzw_inflate_many(segments: Sequence[bytes], expected_size: int):
+    """Batch TIFF-LZW decode on the native pool, or None when the
+    library (with LZW support) is unavailable — callers fall back to the
+    pure-Python decoder."""
+    lib = _load_native()
+    if lib and getattr(lib, "has_lzw", False):
+        return lib.lzw_inflate_many(segments, expected_size)
+    return None
+
+
 def has_fp3() -> bool:
     """Whether the fused native predictor-3 chain is available (library
     built AND carrying the round-3 entry points)."""

@@ -49,6 +49,82 @@ void parallel_for(int64_t n, int n_threads, Fn fn) {
   for (auto& w : workers) w.join();
 }
 
+// TIFF LZW decode (MSB-first bit order with the early-change quirk) —
+// mirrors the Python reference decoder in io/geotiff.py bit for bit.
+// Returns 0 on success, -1 on a corrupt stream / overfull output.
+int lzw_decode_one(const uint8_t* in, int64_t in_size, uint8_t* out,
+                   int64_t out_cap, int64_t* out_len) {
+  constexpr int kClear = 256, kEoi = 257;
+  uint16_t prefix[4096];
+  uint8_t suffix[4096];
+  uint8_t stack[4097];
+  int next = 258;
+  int nbits = 9;
+  int64_t bitpos = 0;
+  const int64_t total_bits = in_size * 8;
+  int prev = -1;
+  int64_t len = 0;
+  while (bitpos + nbits <= total_bits) {
+    const int64_t byte_idx = bitpos >> 3;
+    uint32_t chunk = 0;
+    for (int b = 0; b < 4; ++b) {
+      chunk = (chunk << 8) |
+              (byte_idx + b < in_size ? in[byte_idx + b] : 0);
+    }
+    const int code = static_cast<int>(
+        (chunk >> (32 - nbits - (bitpos & 7))) & ((1u << nbits) - 1));
+    bitpos += nbits;
+    if (code == kEoi) break;
+    if (code == kClear) {
+      next = 258;
+      nbits = 9;
+      prev = -1;
+      continue;
+    }
+    int sp = 0;
+    uint8_t first;
+    if (prev < 0) {
+      if (code > 255) return -1;
+      if (len >= out_cap) return -1;
+      out[len++] = static_cast<uint8_t>(code);
+      first = static_cast<uint8_t>(code);
+      prev = code;
+      // (no table append on the first code after a clear — matches the
+      // Python decoder; early-change check still runs below)
+      if (next >= (1 << nbits) - 1 && nbits < 12) ++nbits;
+      continue;
+    }
+    int walk;
+    if (code < next) {
+      walk = code;
+    } else if (code == next) {
+      // KwKwK: emission = string(prev) + first(string(prev))
+      walk = prev;
+    } else {
+      return -1;
+    }
+    while (walk >= 258) {
+      if (sp >= 4096) return -1;
+      stack[sp++] = suffix[walk];
+      walk = prefix[walk];
+    }
+    stack[sp++] = static_cast<uint8_t>(walk);
+    first = stack[sp - 1];
+    if (len + sp + (code == next ? 1 : 0) > out_cap) return -1;
+    while (sp) out[len++] = stack[--sp];
+    if (code == next) out[len++] = first;
+    if (next < 4096) {
+      prefix[next] = static_cast<uint16_t>(prev);
+      suffix[next] = first;
+      ++next;
+    }
+    prev = code;
+    if (next >= (1 << nbits) - 1 && nbits < 12) ++nbits;
+  }
+  *out_len = len;
+  return 0;
+}
+
 // TIFF predictor-3 inverse (libtiff fpAcc): per row, byte-wise prefix sum
 // with stride nb over the 4 byte-significance planes (MSB plane first),
 // then unshuffle planes back into little-endian float32 samples.
@@ -107,6 +183,29 @@ void fp3_difference(const float* in, int rows, int cols, int nb,
 }  // namespace
 
 extern "C" {
+
+// Batch TIFF-LZW inflate across the worker pool (GDAL's default
+// compression for real-world S2 trees; the Python fallback decodes at
+// ~1 MB/s, crippling at tile-year scale).
+int rk_lzw_inflate_batch(int64_t n, const uint8_t** in_ptrs,
+                         const int64_t* in_sizes, uint8_t* out_buf,
+                         int64_t out_stride, int64_t* out_sizes,
+                         int n_threads) {
+  std::atomic<int> status(0);
+  parallel_for(n, n_threads, [&](int64_t i) {
+    int64_t out_len = 0;
+    int rc = lzw_decode_one(in_ptrs[i], in_sizes[i],
+                            out_buf + i * out_stride, out_stride,
+                            &out_len);
+    if (rc != 0) {
+      status.store(rc);
+      out_sizes[i] = 0;
+    } else {
+      out_sizes[i] = out_len;
+    }
+  });
+  return status.load();
+}
 
 // Fused tile decode for float32 predictor-3 tiles: (optional) zlib
 // inflate + fpAcc + byte unshuffle, one parallel pass over n tiles.
