@@ -848,19 +848,28 @@ def write_geotiff(
     BIGTIFF=YES; BigTIFF here switches on automatically past 3.5 GB or can
     be forced).  ``compress="lzw"`` writes GDAL's default creation option
     instead — an interop/fixture mode (serial Python encoder; keep the
-    DEFLATE fast path for tile-scale outputs).  Streams through
-    :class:`TiledTiffWriter` tile-row by tile-row, so peak memory is one
-    row of compressed tiles, not the whole file."""
+    DEFLATE fast path for tile-scale outputs).  Rasters up to 64 MB raw
+    encode as ONE pool batch (peak memory ~ one padded + one compressed
+    copy of the raster); larger rasters stream through
+    :class:`TiledTiffWriter` tile-row by tile-row, bounding peak memory
+    at one row of compressed tiles."""
     arr = np.asarray(array)
     if arr.ndim == 2:
         arr = arr[:, :, None]
     if arr.dtype not in _DTYPE_TO_TAGS:
         arr = arr.astype(np.float32)
     h, w, nb = arr.shape
+    # Hand the codec pool as many tiles per call as memory sensibly
+    # allows: per-tile-row batches of a ~1000-px-wide raster are only
+    # 4-5 tiles, starving a wide native pool.  Up to ~64 MB raw, encode
+    # the WHOLE raster in one batch (peak memory = one compressed copy);
+    # larger rasters stream per tile row as before.
+    raw_bytes = h * w * nb * arr.dtype.itemsize
+    step = (h or tile_size) if raw_bytes <= (64 << 20) else tile_size
     with TiledTiffWriter(
         path, h, w, n_bands=nb, dtype=arr.dtype, geo=geo,
         tile_size=tile_size, compress=compress, level=level,
         predictor=predictor, bigtiff=bigtiff,
     ) as writer:
-        for y0 in range(0, h, tile_size):
-            writer.write_rows(y0, arr[y0:y0 + tile_size])
+        for y0 in range(0, h, step):
+            writer.write_rows(y0, arr[y0:y0 + step])
