@@ -634,11 +634,10 @@ class TiledTiffWriter:
             )
         self.geo = geo or GeoInfo()
         self.ts = int(tile_size)
-        # compress: True/"deflate" (the reference's KafkaOutput choice,
-        # the parallel native fast path), "lzw" (GDAL's default creation
-        # option — an INTEROP/FIXTURE mode: the pure-Python encoder is
-        # serial and slow, fine for masks/fixtures, wrong for tile-scale
-        # outputs), or False.
+        # compress: True/"deflate" (the reference's KafkaOutput choice),
+        # "lzw" (GDAL's default creation option — native pool-parallel
+        # encoder when built, serial Python fallback otherwise), or
+        # False.
         if compress == "lzw":
             self.codec = "lzw"
         elif compress in (True, "deflate"):
@@ -710,7 +709,8 @@ class TiledTiffWriter:
             raise IndexError(f"tile ({ty}, {tx}) outside grid")
         seg = self._prep_tile(tile)
         if self.codec == "lzw":
-            seg = lzw_encode(seg)
+            native = native_codec.lzw_deflate_many([seg])
+            seg = native[0] if native is not None else lzw_encode(seg)
         elif self.codec == "deflate":
             seg = native_codec.deflate_many([seg], self.level)[0]
         self._append_segment(ty * self.tiles_across + tx, seg)
@@ -750,7 +750,9 @@ class TiledTiffWriter:
         if segs is None:
             raws = [self._prep_tile(t) for t in tiles]
             if self.codec == "lzw":
-                segs = [lzw_encode(r) for r in raws]
+                segs = native_codec.lzw_deflate_many(raws)
+                if segs is None:
+                    segs = [lzw_encode(r) for r in raws]
             elif self.codec == "deflate":
                 segs = native_codec.deflate_many(raws, self.level)
             else:
@@ -847,8 +849,8 @@ def write_geotiff(
     (``observations.py:360-365``: COMPRESS=DEFLATE, TILED=YES, PREDICTOR=1,
     BIGTIFF=YES; BigTIFF here switches on automatically past 3.5 GB or can
     be forced).  ``compress="lzw"`` writes GDAL's default creation option
-    instead — an interop/fixture mode (serial Python encoder; keep the
-    DEFLATE fast path for tile-scale outputs).  Rasters up to 64 MB raw
+    instead (native pool-parallel encoder when built; Python fallback
+    is serial — fine for masks/fixtures).  Rasters up to 64 MB raw
     encode as ONE pool batch (peak memory ~ one padded + one compressed
     copy of the raster); larger rasters stream through
     :class:`TiledTiffWriter` tile-row by tile-row, bounding peak memory

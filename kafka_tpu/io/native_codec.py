@@ -54,6 +54,15 @@ def lzw_inflate_many(segments: Sequence[bytes], expected_size: int):
     return None
 
 
+def lzw_deflate_many(segments: Sequence[bytes]):
+    """Batch TIFF-LZW encode on the native pool (bit-identical to the
+    Python ``geotiff.lzw_encode``), or None when unavailable."""
+    lib = _load_native()
+    if lib and getattr(lib, "has_lzw_enc", False):
+        return lib.lzw_deflate_many(segments)
+    return None
+
+
 def has_fp3() -> bool:
     """Whether the fused native predictor-3 chain is available (library
     built AND carrying the round-3 entry points)."""

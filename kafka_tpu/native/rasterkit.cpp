@@ -125,6 +125,83 @@ int lzw_decode_one(const uint8_t* in, int64_t in_size, uint8_t* out,
   return 0;
 }
 
+// TIFF LZW encode — matched to the decoders above: width switch one
+// append later than the decoder (its table lags by one entry), clear at
+// 4094, and the LZWPostEncode-style final width bump before the EOI.
+int lzw_encode_one(const uint8_t* in, int64_t n, uint8_t* out,
+                   int64_t cap, int64_t* out_len) {
+  constexpr int kHSize = 18013;  // prime, ~4.4x load for 4096 codes
+  std::vector<int32_t> hkey(kHSize, -1);
+  std::vector<uint16_t> hval(kHSize);
+  int64_t len = 0;
+  uint32_t bitbuf = 0;
+  int bitcnt = 0;
+  int nbits = 9;
+  int next = 258;
+  bool ok = true;
+  auto put = [&](int code) {
+    bitbuf = (bitbuf << nbits) | static_cast<uint32_t>(code);
+    bitcnt += nbits;
+    while (bitcnt >= 8) {
+      if (len >= cap) { ok = false; return; }
+      out[len++] = static_cast<uint8_t>((bitbuf >> (bitcnt - 8)) & 0xFF);
+      bitcnt -= 8;
+    }
+  };
+  put(256);
+  int w = -1;
+  for (int64_t i = 0; i < n && ok; ++i) {
+    const int c = in[i];
+    if (w < 0) {
+      w = c;
+      continue;
+    }
+    const int32_t key = (w << 8) | c;
+    int h = static_cast<int>(
+        (static_cast<uint32_t>(key) * 2654435761u) % kHSize);
+    int found = -1;
+    while (hkey[h] != -1) {
+      if (hkey[h] == key) {
+        found = hval[h];
+        break;
+      }
+      h = (h + 1) % kHSize;
+    }
+    if (found >= 0) {
+      w = found;
+      continue;
+    }
+    put(w);
+    hkey[h] = key;
+    hval[h] = static_cast<uint16_t>(next);
+    ++next;
+    if (next >= 4094) {
+      put(256);
+      std::fill(hkey.begin(), hkey.end(), -1);
+      next = 258;
+      nbits = 9;
+    } else if (next >= (1 << nbits) && nbits < 12) {
+      ++nbits;
+    }
+    w = c;
+  }
+  if (w >= 0 && ok) {
+    put(w);
+    if (next >= (1 << nbits) - 1 && nbits < 12) ++nbits;
+  }
+  if (ok) put(257);
+  if (ok && bitcnt) {
+    if (len >= cap) {
+      ok = false;
+    } else {
+      out[len++] = static_cast<uint8_t>((bitbuf << (8 - bitcnt)) & 0xFF);
+    }
+  }
+  if (!ok) return -1;
+  *out_len = len;
+  return 0;
+}
+
 // TIFF predictor-3 inverse (libtiff fpAcc): per row, byte-wise prefix sum
 // with stride nb over the 4 byte-significance planes (MSB plane first),
 // then unshuffle planes back into little-endian float32 samples.
@@ -195,6 +272,29 @@ int rk_lzw_inflate_batch(int64_t n, const uint8_t** in_ptrs,
   parallel_for(n, n_threads, [&](int64_t i) {
     int64_t out_len = 0;
     int rc = lzw_decode_one(in_ptrs[i], in_sizes[i],
+                            out_buf + i * out_stride, out_stride,
+                            &out_len);
+    if (rc != 0) {
+      status.store(rc);
+      out_sizes[i] = 0;
+    } else {
+      out_sizes[i] = out_len;
+    }
+  });
+  return status.load();
+}
+
+// Batch TIFF-LZW deflate across the worker pool (makes the writer's
+// compress="lzw" GDAL-compatibility mode a parallel production path
+// instead of the serial Python encoder).
+int rk_lzw_deflate_batch(int64_t n, const uint8_t** in_ptrs,
+                         const int64_t* in_sizes, uint8_t* out_buf,
+                         int64_t out_stride, int64_t* out_sizes,
+                         int n_threads) {
+  std::atomic<int> status(0);
+  parallel_for(n, n_threads, [&](int64_t i) {
+    int64_t out_len = 0;
+    int rc = lzw_encode_one(in_ptrs[i], in_sizes[i],
                             out_buf + i * out_stride, out_stride,
                             &out_len);
     if (rc != 0) {

@@ -560,3 +560,15 @@ class TestLZW:
         got = native_codec.lzw_inflate_many(encs, max(spans))
         if got is not None:
             assert got == raws
+
+    def test_native_encoder_streams_match_python(self):
+        """rk_lzw_deflate_batch must emit bit-identical streams to the
+        Python lzw_encode (same width-switch/clear/EOI policy)."""
+        from kafka_tpu.io import native_codec
+        from kafka_tpu.io.geotiff import lzw_encode
+
+        raws = self._cases()
+        got = native_codec.lzw_deflate_many(raws)
+        if got is None:
+            pytest.skip("native LZW encoder unavailable")
+        assert got == [lzw_encode(r) for r in raws]
