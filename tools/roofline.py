@@ -1,0 +1,291 @@
+"""Roofline accounting for the hot solver kernels (round-4 item #1).
+
+The cross-round bench compares the device rate only to the CPU oracle;
+this tool answers the other question — how close is the kernel to what
+the *chip* can do?  For each component of the hot path it reports:
+
+- measured ms/call at the operating size (queued-slope method — the
+  tunneled client defers execution and poisons dispatch latency, so
+  naive timings are fiction; see BASELINE.md methodology),
+- XLA's post-fusion cost model (``compiled.cost_analysis()``): HBM bytes
+  accessed + flops of the optimised HLO,
+- achieved GB/s and GFLOP/s derived from the two,
+- percent of the v5e's public roofs: 819 GB/s HBM bandwidth and
+  197 TFLOP/s bf16 MXU peak (the packed path is float32 VPU work, so
+  the bandwidth roof is the binding one — flops are reported to show
+  the arithmetic intensity, not as a utilisation claim),
+- the *analytic minimum* HBM traffic (read every live input once, write
+  every output once) as the fusion-perfect lower bound.
+
+Components, at n = 2^19 pixels (the benchmark operating size):
+
+- ``linearize``: the operator's batched value+Jacobian (twostream p=7
+  and exact-SAIL PROSAIL p=10),
+- ``update``: packed normal-equations assembly + packed Cholesky +
+  substitutions, given a linearisation (``core.solvers.kalman_update``),
+- ``gn_full``: the production Gauss-Newton ``lax.while_loop``
+  (``assimilate_date_jit``, 2 iterations on this problem).
+
+Usage:  python tools/roofline.py [--n 524288] [--json out.json]
+
+Single-process, serialized with nothing else on the TPU (host is
+1-core; concurrent compute skews queued-slope timings).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+# v5e public roofs (jax-ml.github.io/scaling-book: v5e 16 GB HBM at
+# 819 GB/s; 197 TFLOP/s bf16).
+HBM_GBPS = 819.0
+PEAK_TFLOPS_BF16 = 197.0
+
+
+def slope_time(fn, flush, k1=5, k2=25, reps=5, target_s=1.5):
+    """Sustained per-call seconds via the queued-slope method."""
+
+    def run_k(k):
+        t0 = time.perf_counter()
+        r = None
+        for _ in range(k):
+            r = fn()
+        flush(r)
+        return time.perf_counter() - t0
+
+    while (run_k(k2) - run_k(k1)) < target_s and k2 < 8000:
+        k2 = min(k2 * 4, 8000)
+    slopes = [(run_k(k2) - run_k(k1)) / (k2 - k1) for _ in range(reps)]
+    return float(np.median(slopes)), float(max(slopes) - min(slopes))
+
+
+def cost_of(compiled):
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    return float(ca.get("bytes accessed", float("nan"))), float(
+        ca.get("flops", float("nan"))
+    )
+
+
+def nbytes_tree(tree):
+    import jax
+
+    return sum(
+        int(np.prod(l.shape)) * l.dtype.itemsize
+        for l in jax.tree.leaves(tree)
+        if hasattr(l, "shape")
+    )
+
+
+def measure(name, jitted, args, flush_leaf, rows, min_traffic=None,
+            note=""):
+    import jax
+
+    lowered = jitted.lower(*args)
+    compiled = lowered.compile()
+    xla_bytes, xla_flops = cost_of(compiled)
+    out = jitted(*args)  # warm
+    flush_leaf(out)
+    dt, spread = slope_time(lambda: jitted(*args), flush_leaf)
+    row = {
+        "component": name,
+        "ms": dt * 1e3,
+        "ms_spread": spread * 1e3,
+        "xla_bytes": xla_bytes,
+        "xla_flops": xla_flops,
+        "achieved_gbps": xla_bytes / dt / 1e9,
+        "achieved_gflops": xla_flops / dt / 1e9,
+        "pct_hbm_roof": 100.0 * (xla_bytes / dt / 1e9) / HBM_GBPS,
+        "min_traffic_bytes": min_traffic,
+        "note": note,
+    }
+    if min_traffic:
+        # Time the kernel would take if it only moved the live inputs and
+        # outputs once at the full bandwidth roof.
+        row["fusion_perfect_ms"] = min_traffic / (HBM_GBPS * 1e9) * 1e3
+    rows.append(row)
+    print(
+        f"{name:24s} {dt*1e3:8.2f} ms  (spread {spread*1e3:.2f})  "
+        f"XLA {xla_bytes/1e6:8.1f} MB  {xla_flops/1e9:7.2f} GFLOP  "
+        f"-> {row['achieved_gbps']:6.1f} GB/s "
+        f"({row['pct_hbm_roof']:.1f}% of HBM roof)",
+        file=sys.stderr,
+    )
+    return row
+
+
+def tip_components(n_pix, rows):
+    import jax
+    import jax.numpy as jnp
+
+    from kafka_tpu.core.solvers import assimilate_date_jit, kalman_update
+    from kafka_tpu.testing.synthetic import make_tip_problem
+
+    op, bands, x0, p_inv0 = make_tip_problem(n_pix)
+    p = op.n_params
+    n_bands = op.n_bands
+    opts = {
+        "state_bounds": (
+            jnp.asarray(op.state_bounds[0]), jnp.asarray(op.state_bounds[1])
+        )
+    }
+    f32 = 4
+
+    # -- linearize: reads x (n,p), writes h0 (B,n) + jac (B,n,p).
+    lin_jit = jax.jit(lambda x: op.linearize(None, x))
+    min_lin = n_pix * f32 * (p + n_bands * (1 + p))
+    measure(
+        f"tip/linearize", lin_jit, (x0,),
+        lambda o: np.asarray(o.h0[0, :1]), rows, min_lin,
+        note=f"value+jacfwd, p={p}, {n_bands} bands",
+    )
+
+    # -- update: reads lin + obs + x_lin + x_f + p_inv_f, writes x + A.
+    lin = jax.block_until_ready(lin_jit(x0))
+    upd_jit = jax.jit(
+        lambda l, b, xl, xf, pf: kalman_update(l, b, xl, xf, pf)
+    )
+    min_upd = n_pix * f32 * (
+        n_bands * (1 + p)          # h0 + jac
+        + 3 * n_bands              # y, r_inv, mask (mask is bool=1B; round up)
+        + 2 * p                    # x_lin, x_f
+        + p * p                    # p_inv_f (dense as stored)
+        + p                        # x out
+        + p * p                    # A out
+    )
+    measure(
+        f"tip/update", upd_jit, (lin, bands, x0, x0, p_inv0),
+        lambda o: np.asarray(o[0][:1, 0]), rows, min_upd,
+        note="packed assembly + packed Cholesky + substitution",
+    )
+
+    # -- full GN while_loop (production path).
+    args = (op.linearize, bands, x0, p_inv0, None, opts)
+    full = lambda: assimilate_date_jit(*args)
+    out = full()
+    n_iters = int(out[2].n_iterations)
+    # Fusion-perfect traffic for the WHOLE solve: inputs once, outputs
+    # once — iterations live in VMEM/registers in the ideal kernel.
+    min_full = n_pix * f32 * (
+        3 * n_bands + 2 * p + p * p   # obs + x_f(+x_lin=x_f) + p_inv_f
+        + p + p * p                   # x out + A out
+    )
+    row = measure(
+        f"tip/gn_full", _full_jit(op, opts), (bands, x0, p_inv0),
+        lambda o: np.asarray(o[0][:1, 0]), rows, min_full,
+        note=f"{n_iters} GN iterations (lax.while_loop)",
+    )
+    row["n_iterations"] = n_iters
+    return rows
+
+
+def _full_jit(op, opts):
+    import jax
+
+    from kafka_tpu.core.solvers import assimilate_date_jit
+
+    def f(bands, x0, p_inv0):
+        return assimilate_date_jit(op.linearize, bands, x0, p_inv0,
+                                   None, opts)
+
+    return jax.jit(f)
+
+
+def prosail_components(n_pix, rows):
+    import jax
+    import jax.numpy as jnp
+
+    from kafka_tpu.cli.drivers import prosail_aux_builder
+    from kafka_tpu.core.solvers import kalman_update
+    from kafka_tpu.core.types import BandBatch
+    from kafka_tpu.engine.priors import sail_prior
+    from kafka_tpu.obsops.prosail import ProsailOperator
+
+    op = ProsailOperator()
+    p = op.n_params
+    n_bands = op.n_bands
+    prior = sail_prior()
+    rng = np.random.default_rng(0)
+    mean = np.asarray(prior.prior.mean, np.float32)
+    inv = np.asarray(prior.prior.inv_cov, np.float32)
+    x0 = jnp.asarray(
+        np.clip(mean + rng.normal(0, 0.02, (n_pix, p)), 0.02, 0.98)
+        .astype(np.float32)
+    )
+    p_inv0 = jnp.broadcast_to(jnp.asarray(inv), (n_pix, p, p))
+    aux = prosail_aux_builder(
+        {"sza": 30.0, "saa": 120.0, "vza": 5.0, "vaa": 200.0}, None
+    )
+    f32 = 4
+
+    lin_jit = jax.jit(lambda x: op.linearize(aux, x))
+    min_lin = n_pix * f32 * (p + n_bands * (1 + p))
+    measure(
+        "prosail/linearize", lin_jit, (x0,),
+        lambda o: np.asarray(o.h0[0, :1]), rows, min_lin,
+        note=f"exact-SAIL value+jacfwd, p={p}, {n_bands} bands",
+    )
+
+    lin = jax.block_until_ready(lin_jit(x0))
+    y = np.asarray(lin.h0) + rng.normal(
+        0, 0.005, (n_bands, n_pix)
+    ).astype(np.float32)
+    mask = np.ones((n_bands, n_pix), bool)
+    bands = BandBatch(
+        y=jnp.asarray(y.astype(np.float32)),
+        r_inv=jnp.asarray(np.full((n_bands, n_pix), 1 / 0.005**2, np.float32)),
+        mask=jnp.asarray(mask),
+    )
+    upd_jit = jax.jit(
+        lambda l, b, xl, xf, pf: kalman_update(l, b, xl, xf, pf)
+    )
+    min_upd = n_pix * f32 * (
+        n_bands * (1 + p) + 3 * n_bands + 2 * p + p * p + p + p * p
+    )
+    measure(
+        "prosail/update", upd_jit, (lin, bands, x0, x0, p_inv0),
+        lambda o: np.asarray(o[0][:1, 0]), rows, min_upd,
+        note="packed assembly + packed Cholesky + substitution",
+    )
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1 << 19)
+    ap.add_argument("--json", type=str, default=None)
+    ap.add_argument("--skip-prosail", action="store_true")
+    args = ap.parse_args()
+
+    from kafka_tpu.utils.compilation_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    import jax
+
+    np.asarray(jax.jit(lambda v: v + 1)(jax.numpy.zeros(8)))  # sync regime
+
+    rows: list = []
+    tip_components(args.n, rows)
+    if not args.skip_prosail:
+        prosail_components(args.n, rows)
+
+    out = {
+        "n_pix": args.n,
+        "hbm_gbps_roof": HBM_GBPS,
+        "platform": jax.devices()[0].platform,
+        "rows": rows,
+    }
+    print(json.dumps(out, indent=1))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
