@@ -14,13 +14,14 @@ results.  Layout contract: coefficient ``(i, j)`` with ``j <= i`` of the
 lower triangle lives at row ``i (i + 1) / 2 + j``, matching
 ``linalg.cholesky_packed``'s list-of-lists ordering.
 
-Measured on a real v5e chip (TIP problem, 2^19 pixels, full GN loop):
-21.3 ms/solve vs 19.4 ms for the XLA-fused path — XLA's automatic fusion
-is already near-optimal for this pure-VPU workload, which is why the
-kernel is opt-in rather than default.  It exists as the Mosaic foothold
-for work XLA cannot schedule (fusing the normal-equations assembly's
-band reduction into the factorisation, block-resident multi-iteration
-solves).
+Two generations of kernel live here.  ``solve_rows`` (factor+solve only)
+was the first: measured 21.3 ms/solve vs 19.4 ms for the XLA path on the
+full GN loop — XLA's automatic fusion already near-optimal for that
+slice, so it stayed opt-in.  ``_fused_update_rows`` fuses the WHOLE
+per-date update (assembly + factor + solve + innovations) into one
+launch; on a real v5e (TIP, 2^19 px, full 2-iteration GN loop,
+queued-slope timing) it takes the solve from ~6.4 ms to ~3.9 ms.  The
+single measured story lives in BASELINE.md's "Roofline" section.
 """
 
 from __future__ import annotations
@@ -96,7 +97,7 @@ def _fused_update_kernel(p: int, n_bands: int, jac_ref, h0_ref, y_ref,
                          x_ref, a_ref, inn_ref):
     """One pixel block of the WHOLE per-date update, VMEM-resident:
 
-        y~   = mask * (y + J x_lin - H0)
+        y~   = where(mask, y + J x_lin - H0, 0)
         A    = sum_b w_b J_b J_b^T + P_f^-1        (packed lower triangle)
         rhs  = sum_b w_b y~_b J_b + P_f^-1 x_f
         x    = A^-1 rhs                            (packed Cholesky)
@@ -118,14 +119,21 @@ def _fused_update_kernel(p: int, n_bands: int, jac_ref, h0_ref, y_ref,
         [jac_ref[b * p + k, :] for k in range(p)] for b in range(n_bands)
     ]
     w = [w_ref[b, :] for b in range(n_bands)]
-    # y~ = mask * (y + J x_lin - H0): the reference's np.where(mask, y, 0)
-    # guard (solvers.py:53) with the relinearisation shift (:56,:95).
+    # y~ = where(mask, y + J x_lin - H0, 0): the reference's
+    # np.where(mask, y, 0) guard (solvers.py:53) with the relinearisation
+    # shift (:56,:95).  A select, NOT mask multiplication: masked-out
+    # positions hold NaN nodata (io/warp.py default) and 0 * NaN = NaN
+    # would poison the whole solve.
     y_t = []
     for b in range(n_bands):
         jx = jac[b][0] * xl_ref[0, :]
         for k in range(1, p):
             jx = jx + jac[b][k] * xl_ref[k, :]
-        y_t.append(m_ref[b, :] * (y_ref[b, :] + jx - h0_ref[b, :]))
+        y_t.append(
+            jnp.where(
+                m_ref[b, :] > 0, y_ref[b, :] + jx - h0_ref[b, :], 0.0
+            )
+        )
     wj = [[w[b] * jac[b][i] for i in range(p)] for b in range(n_bands)]
     a_pk = [[None] * p for _ in range(p)]
     for i in range(p):
@@ -150,11 +158,15 @@ def _fused_update_kernel(p: int, n_bands: int, jac_ref, h0_ref, y_ref,
         for j in range(i + 1):
             a_ref[idx(i, j), :] = a_pk[i][j]
     # Innovations are state-independent diagnostics — free while the
-    # operands are block-resident: mask * (y - H0) (solvers.py:139-142).
+    # operands are block-resident: where(mask, y - H0, 0)
+    # (solvers.py:139-142; select not multiplication, same NaN-nodata
+    # reasoning as y~ above).
     # (fwd = J (x - x_f) + H0 is NOT computed here: it must see the
     # damped/bounds-projected iterate, which is applied outside.)
     for b in range(n_bands):
-        inn_ref[b, :] = m_ref[b, :] * (y_ref[b, :] - h0_ref[b, :])
+        inn_ref[b, :] = jnp.where(
+            m_ref[b, :] > 0, y_ref[b, :] - h0_ref[b, :], 0.0
+        )
 
 
 @functools.partial(jax.jit, static_argnums=(8, 9))

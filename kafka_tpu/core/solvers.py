@@ -166,9 +166,12 @@ def kalman_update(
     packed elementwise path; the dense einsum+Cholesky form is the fallback
     for large p.  The dense ``A`` is still materialised once per update for
     the information-matrix output, but nothing in the solve reads it back.
-    ``use_pallas`` routes the packed factor+solve through the hand-written
-    Pallas kernel (``core.pallas_solve``) instead of XLA-fused elementwise
-    ops.
+    ``use_pallas`` runs the ENTIRE update (normal-equations assembly +
+    packed Cholesky factor + substitution + innovation diagnostics) as one
+    VMEM-resident Pallas kernel (``core.pallas_solve.fused_update_pallas``)
+    instead of XLA-fused elementwise ops; masked positions are excluded by
+    ``jnp.where`` selects in both paths, so NaN nodata under a False mask
+    stays inert either way.
     """
     # The unrolled assembly emits O(n_bands * p^2) traced ops; past ~32
     # bands (hyperspectral) the three-op dense einsum compiles faster.
@@ -229,9 +232,11 @@ def _iterated_solve_rows(
     - assembly + Cholesky + substitution + innovations run as ONE
       VMEM-resident kernel (``pallas_solve._fused_update_rows``).
 
-    Measured at p=7, 2 bands, 2^19 px on a v5e: 6.45 ms -> ~2.5 ms for
-    the full 2-iteration solve (tools/roofline.py; the kernel itself sits
-    at the HBM roof).
+    Measured at p=7, 2 bands, 2^19 px on a v5e (queued-slope method):
+    6.4 ms -> ~3.9 ms for the full 2-iteration solve, a ~1.6x speedup
+    over the XLA-fused path.  Still well above the ~0.3 ms fusion-perfect
+    traffic bound — the remaining gap is the Jacobian relayout and the
+    while_loop carry, not the kernel (see BASELINE.md "Roofline").
     """
     from .pallas_solve import _fused_update_rows, tri_rows
 
@@ -271,8 +276,14 @@ def _iterated_solve_rows(
         )
         x_new = x_rows + relaxation * (x_raw - x_rows)
         if state_bounds is not None:
-            lo, hi = state_bounds
-            x_new = jnp.clip(x_new, lo[:, None], hi[:, None])
+            # Accept the same bound shapes the XLA branch's
+            # jnp.clip(x, lo, hi) does: scalars broadcast, (p,) vectors
+            # go per-parameter (the row layout needs the trailing
+            # lane axis added).
+            lo, hi = (jnp.asarray(v) for v in state_bounds)
+            lo = lo[:, None] if lo.ndim else lo
+            hi = hi[:, None] if hi.ndim else hi
+            x_new = jnp.clip(x_new, lo, hi)
         # fwd = J (x - x_f) + H0 with the damped/projected iterate
         # (solvers.py:70-71,135-136).
         fwd = jnp.stack([
