@@ -20,8 +20,8 @@ full GN loop — XLA's automatic fusion already near-optimal for that
 slice, so it stayed opt-in.  ``_fused_update_rows`` fuses the WHOLE
 per-date update (assembly + factor + solve + innovations) into one
 launch; on a real v5e (TIP, 2^19 px, full 2-iteration GN loop,
-queued-slope timing) it takes the solve from ~6.4 ms to ~3.9 ms.  The
-single measured story lives in BASELINE.md's "Roofline" section.
+queued-slope timing) it takes the solve from 6.45 ms to 3.80 ms (~1.7x).
+The single measured story lives in BASELINE.md's "Roofline" section.
 """
 
 from __future__ import annotations

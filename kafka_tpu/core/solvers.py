@@ -233,7 +233,7 @@ def _iterated_solve_rows(
       VMEM-resident kernel (``pallas_solve._fused_update_rows``).
 
     Measured at p=7, 2 bands, 2^19 px on a v5e (queued-slope method):
-    6.4 ms -> ~3.9 ms for the full 2-iteration solve, a ~1.6x speedup
+    6.45 ms -> 3.80 ms for the full 2-iteration solve, a ~1.7x speedup
     over the XLA-fused path.  Still well above the ~0.3 ms fusion-perfect
     traffic bound — the remaining gap is the Jacobian relayout and the
     while_loop carry, not the kernel (see BASELINE.md "Roofline").
@@ -277,12 +277,36 @@ def _iterated_solve_rows(
         x_new = x_rows + relaxation * (x_raw - x_rows)
         if state_bounds is not None:
             # Accept the same bound shapes the XLA branch's
-            # jnp.clip(x, lo, hi) does: scalars broadcast, (p,) vectors
-            # go per-parameter (the row layout needs the trailing
-            # lane axis added).
-            lo, hi = (jnp.asarray(v) for v in state_bounds)
-            lo = lo[:, None] if lo.ndim else lo
-            hi = hi[:, None] if hi.ndim else hi
+            # jnp.clip(x, lo, hi) does: scalars broadcast, (p,) vectors go
+            # per-parameter, (n_pix, p) arrays go per-pixel — the row
+            # layout transposes the last to (p, n_pix) lane rows and adds
+            # the trailing lane axis to vectors.  Anything else fails HERE
+            # with a shape message, not as an opaque while_loop
+            # carry-shape error three frames deeper.
+            def to_rows(v):
+                v = jnp.asarray(v)
+                if v.ndim == 0:
+                    return v
+                if v.ndim == 1:
+                    if v.shape[0] != p:
+                        raise ValueError(
+                            f"state_bounds vector has {v.shape[0]} "
+                            f"entries for p={p} parameters"
+                        )
+                    return v[:, None]
+                if v.ndim == 2:
+                    if v.shape != (n_pix, p):
+                        raise ValueError(
+                            f"state_bounds array has shape {v.shape}; "
+                            f"expected (n_pix, p) = ({n_pix}, {p})"
+                        )
+                    return v.T
+                raise ValueError(
+                    "state_bounds must be scalar, (p,) or (n_pix, p); "
+                    f"got ndim={v.ndim}"
+                )
+
+            lo, hi = (to_rows(v) for v in state_bounds)
             x_new = jnp.clip(x_new, lo, hi)
         # fwd = J (x - x_f) + H0 with the damped/projected iterate
         # (solvers.py:70-71,135-136).
@@ -742,7 +766,7 @@ def assimilate_date_jit(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 9, 11, 12, 13))
+@functools.partial(jax.jit, static_argnums=(0, 9, 11, 12, 13, 14))
 def _assimilate_scan_impl(
     linearize: LinearizeFn,
     obs_stacked: BandBatch,
@@ -758,6 +782,7 @@ def _assimilate_scan_impl(
     hessian_forward: Any,
     linearize_block: Any,
     per_pixel_convergence: bool,
+    use_pallas: bool,
 ):
     from .linalg import batched_diagonal, spd_inverse_batched
     from .propagators import advance as advance_fn
@@ -778,6 +803,7 @@ def _assimilate_scan_impl(
             linearize, bands_k, x_f, p_f_inv, aux_k,
             hessian_forward=hessian_forward,
             linearize_block=linearize_block,
+            use_pallas=use_pallas,
             per_pixel_convergence=per_pixel_convergence, **opts
         )
         out = (
@@ -836,7 +862,11 @@ def assimilate_windows_scan(
     """
     opts = dict(solver_options or {})
     block = opts.pop("linearize_block", None)
-    opts.pop("use_pallas", None)  # structural; not supported under scan
+    # Structural (static) options split out exactly as in
+    # assimilate_date_jit: ``use_pallas`` swaps each scan step's solve for
+    # the fused VMEM-resident kernel — the scan carries it as a static
+    # argument, so the fused and XLA programs are distinct jit entries.
+    use_pallas = bool(opts.pop("use_pallas", False))
     per_pixel = bool(opts.pop("per_pixel_convergence", False))
     if m_matrix is None:
         m_matrix = jnp.eye(x_analysis0.shape[-1], dtype=jnp.float32)
@@ -846,5 +876,5 @@ def assimilate_windows_scan(
         linearize, obs_stacked, x_analysis0, p_inv_analysis0, aux_stacked,
         m_matrix, q_diag, prior_mean, prior_inv, state_propagator,
         opts or None, hessian_forward,
-        None if block is None else int(block), per_pixel,
+        None if block is None else int(block), per_pixel, use_pallas,
     )

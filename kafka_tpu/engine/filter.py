@@ -540,12 +540,11 @@ class KalmanFilter:
     _SCAN_MAX_AUX_BYTES = 64 * 1024 * 1024
 
     def _fusion_possible(self) -> bool:
-        """Engine-level fusability: a date-invariant (or absent) prior, and
-        no opt-in Pallas kernel (structural option the scan path does not
-        carry — silently dropping it would be worse than not fusing)."""
+        """Engine-level fusability: a date-invariant (or absent) prior.
+        ``use_pallas`` composes with fusion — the scan threads it through
+        as a static argument, so each step's solve runs the fused
+        VMEM-resident kernel (parity-tested in tests/test_fusion.py)."""
         if self.scan_window <= 1 or self.band_sequential:
-            return False
-        if (self.solver_options or {}).get("use_pallas"):
             return False
         return self.prior is None or bool(
             getattr(self.prior, "date_invariant", False)

@@ -72,7 +72,10 @@ class WCMJointOperator(ObservationModel):
         self.polarisations = tuple(polarisations)
         for pol in self.polarisations:
             if pol not in WCM_PARAMETERS:
-                raise ValueError("Only VV and VH polarisations available!")
+                raise ValueError(
+                    f"polarisation {pol!r} has no WCM coefficient set "
+                    "(VV and VH are supported)"
+                )
         self.n_bands = len(self.polarisations)
         self._coeffs = np.array(
             [WCM_PARAMETERS[p] for p in self.polarisations], np.float32

@@ -78,7 +78,8 @@ class WCMOperator(ObservationModel):
         for pol in self.polarisations:
             if pol not in WCM_PARAMETERS:
                 raise ValueError(
-                    "Only VV and VH polarisations available!"
+                    f"unsupported polarisation {pol!r}: WCM "
+                    "coefficients are calibrated for VV and VH"
                 )
         self.n_bands = len(self.polarisations)
         self._coeffs = np.array(

@@ -27,6 +27,16 @@ try:
 except Exception:
     pass
 
+# Persistent compilation cache (same helper the drivers and bench.py call,
+# scoped to the cpu-pinned configuration): the tier-1 suite is dominated
+# by re-compiling the same solver/scan/jacfwd programs every run on this
+# one-core host, and a warm cache turns those into disk hits.
+from kafka_tpu.utils.compilation_cache import (  # noqa: E402
+    enable_compilation_cache,
+)
+
+enable_compilation_cache()
+
 
 def cpu_devices():
     return jax.devices("cpu")

@@ -71,6 +71,14 @@ def test_two_process_distributed_run(tmp_path):
             for i, p in enumerate(log_paths)
         )
 
+    text = logs_text()
+    if "Multiprocess computations aren't implemented on the CPU" in text:
+        # Capability limit of THIS jaxlib build, not a bug in the
+        # scheduler under test: the bundled XLA:CPU backend has no
+        # cross-process collective support, so the workers can form the
+        # coordinator but never run the psum.  On builds with the Gloo
+        # CPU collectives (or real multi-host TPU) the test runs fully.
+        pytest.skip("jaxlib CPU backend lacks multiprocess collectives")
     for i, p in enumerate(procs):
         assert p.returncode == 0, f"worker {i} failed:\n{logs_text()}"
 

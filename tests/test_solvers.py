@@ -419,8 +419,16 @@ class TestPallasSolve:
             op.linearize, bands, x0, p_inv0, None,
             {**opts, "use_pallas": True},
         )
+        # Tolerance 2e-3, NOT float-exact: the fused kernel accumulates
+        # the rank-1 band sums in a different order than XLA's ~40-fusion
+        # schedule, and the Gauss-Newton relinearisation feeds those
+        # last-ulp float32 differences back on itself across iterations.
+        # Measured drift on the real chip at 2^19 px is max |dx| = 1.28e-3
+        # (round-5 verification, queued-slope session); 2e-3 covers it
+        # with margin while still catching semantic bugs, which show up
+        # orders of magnitude larger (wrong mask handling ~1e-1+).
         np.testing.assert_allclose(
-            np.asarray(x_pl), np.asarray(x_ref), atol=5e-4
+            np.asarray(x_pl), np.asarray(x_ref), atol=2e-3
         )
         assert int(d_pl.n_iterations) == int(d_ref.n_iterations)
 
@@ -437,6 +445,179 @@ class TestPallasSolve:
                 solve_spd_packed_pallas(a_packed, b, interpret=True)
             )
             np.testing.assert_allclose(x_pl, x_ref, rtol=2e-5, atol=2e-5)
+
+    def test_fused_kernel_single_update_parity(self):
+        """Tier-1 guard on the fused kernel itself: ONE whole-update launch
+        (CPU interpreter) against the packed XLA assembly + solve, so the
+        kernel path is exercised on every test run, not only on TPU —
+        single update, no GN feedback, so tolerance stays tight.  NaN
+        nodata rides under the mask exactly as ``io/warp.py`` produces
+        it; p covers both real states (7 TIP, 10 PROSAIL)."""
+        from kafka_tpu.core.linalg import solve_spd_packed, unpack_symmetric
+        from kafka_tpu.core.pallas_solve import fused_update_pallas
+        from kafka_tpu.core.solvers import build_normal_equations_packed
+
+        for p in (7, 10):
+            jac, h0, y, r_inv, mask, x_f, x_lin, p_inv = random_problem(
+                n_pix=256, p=p, n_bands=2 if p == 7 else 10,
+                mask_frac=0.3,
+            )
+            obs = BandBatch(
+                y=jnp.asarray(np.where(mask, y, np.nan)),
+                r_inv=jnp.asarray(np.where(mask, r_inv, 0.0)),
+                mask=jnp.asarray(mask),
+            )
+            lin = Linearization(h0=jnp.asarray(h0), jac=jnp.asarray(jac))
+            a_packed, b = build_normal_equations_packed(
+                lin, obs, jnp.asarray(x_lin), jnp.asarray(x_f),
+                jnp.asarray(p_inv),
+            )
+            x_ref = np.asarray(solve_spd_packed(a_packed, b))
+            a_ref = np.asarray(unpack_symmetric(a_packed))
+            x_pl, a_pl_packed = fused_update_pallas(
+                lin, obs, jnp.asarray(x_lin), jnp.asarray(x_f),
+                jnp.asarray(p_inv), interpret=True,
+            )
+            x_pl = np.asarray(x_pl)
+            a_pl = np.asarray(unpack_symmetric(a_pl_packed))
+            assert np.isfinite(x_pl).all(), f"p={p}: NaN leaked into x"
+            assert np.isfinite(a_pl).all(), f"p={p}: NaN leaked into A"
+            np.testing.assert_allclose(x_pl, x_ref, rtol=1e-4, atol=1e-4)
+            np.testing.assert_allclose(a_pl, a_ref, rtol=1e-4, atol=1e-4)
+
+    def test_use_pallas_nan_nodata_full_loop(self):
+        """NaN nodata under a False mask (``io/warp.py`` default) must be
+        inert through the WHOLE fused Gauss-Newton loop: selects, not
+        mask multiplication (0 * NaN = NaN would poison every pixel).
+        Asserts parity of the state, the information matrix AND the
+        diagnostics against the XLA path fed the same NaN inputs."""
+        import jax.numpy as jnp
+
+        from kafka_tpu.core.solvers import assimilate_date_jit
+        from kafka_tpu.testing.synthetic import make_tip_problem
+
+        op, bands, x0, p_inv0 = make_tip_problem(512, mask_prob=0.3)
+        mask = np.asarray(bands.mask)
+        y_nan = jnp.asarray(
+            np.where(mask, np.asarray(bands.y), np.nan).astype(np.float32)
+        )
+        nan_bands = BandBatch(y=y_nan, r_inv=bands.r_inv, mask=bands.mask)
+        opts = {"state_bounds": (
+            jnp.asarray(op.state_bounds[0]), jnp.asarray(op.state_bounds[1])
+        )}
+        x_ref, a_ref, d_ref = assimilate_date_jit(
+            op.linearize, nan_bands, x0, p_inv0, None, opts
+        )
+        x_pl, a_pl, d_pl = assimilate_date_jit(
+            op.linearize, nan_bands, x0, p_inv0, None,
+            {**opts, "use_pallas": True},
+        )
+        x_pl, a_pl = np.asarray(x_pl), np.asarray(a_pl)
+        assert np.isfinite(x_pl).all(), "NaN nodata leaked into the state"
+        assert np.isfinite(a_pl).all(), "NaN nodata leaked into A"
+        # GN-feedback tolerance, same reasoning as the parity test above.
+        np.testing.assert_allclose(x_pl, np.asarray(x_ref), atol=2e-3)
+        np.testing.assert_allclose(
+            a_pl, np.asarray(a_ref), rtol=2e-2, atol=2e-2
+        )
+        assert int(d_pl.n_iterations) == int(d_ref.n_iterations)
+        for field in ("innovations", "fwd_modelled"):
+            got = np.asarray(getattr(d_pl, field))
+            want = np.asarray(getattr(d_ref, field))
+            assert np.isfinite(got).all(), f"NaN leaked into {field}"
+            np.testing.assert_allclose(got, want, atol=5e-3,
+                                       err_msg=field)
+
+    @pytest.mark.slow
+    def test_use_pallas_prosail_p10(self):
+        """The fused path at the OTHER production state size: PROSAIL
+        p=10, 10 bands, NaN nodata under the mask.  Slow-marked: the
+        exact-SAIL jacfwd compile dominates (~80 s on the CPU mesh);
+        tier-1 keeps p=10 kernel coverage via the fast
+        ``test_fused_kernel_single_update_parity`` above."""
+        import jax.numpy as jnp
+
+        from kafka_tpu.core.solvers import assimilate_date_jit
+        from kafka_tpu.engine.priors import sail_prior
+        from kafka_tpu.obsops.prosail import ProsailAux, ProsailOperator
+
+        op = ProsailOperator()
+        rng = np.random.default_rng(11)
+        n_pix, p = 256, op.n_params
+        prior = sail_prior().prior
+        mean = np.asarray(prior.mean, np.float32)
+        x0 = jnp.asarray(np.clip(
+            mean + rng.normal(0, 0.02, (n_pix, p)), 0.02, 0.98
+        ).astype(np.float32))
+        p_inv0 = jnp.broadcast_to(
+            jnp.asarray(np.asarray(prior.inv_cov, np.float32)),
+            (n_pix, p, p),
+        )
+        aux = ProsailAux(sza=jnp.asarray(30.0), vza=jnp.asarray(5.0),
+                         raa=jnp.asarray(90.0))
+        h0 = np.asarray(op.linearize(aux, x0).h0)
+        y = (h0 + rng.normal(0, 0.005, h0.shape)).astype(np.float32)
+        mask = rng.uniform(size=y.shape) > 0.2
+        bands = BandBatch(
+            y=jnp.asarray(np.where(mask, y, np.nan).astype(np.float32)),
+            r_inv=jnp.asarray(
+                np.where(mask, 1 / 0.005**2, 0.0).astype(np.float32)
+            ),
+            mask=jnp.asarray(mask),
+        )
+        opts = {"state_bounds": (
+            jnp.asarray(op.state_bounds[0]), jnp.asarray(op.state_bounds[1])
+        )}
+        x_ref, a_ref, d_ref = assimilate_date_jit(
+            op.linearize, bands, x0, p_inv0, aux, opts
+        )
+        x_pl, a_pl, d_pl = assimilate_date_jit(
+            op.linearize, bands, x0, p_inv0, aux,
+            {**opts, "use_pallas": True},
+        )
+        x_pl, a_pl = np.asarray(x_pl), np.asarray(a_pl)
+        assert np.isfinite(x_pl).all() and np.isfinite(a_pl).all()
+        np.testing.assert_allclose(x_pl, np.asarray(x_ref), atol=2e-3)
+        np.testing.assert_allclose(
+            a_pl, np.asarray(a_ref), rtol=2e-2, atol=2e-2
+        )
+        assert int(d_pl.n_iterations) == int(d_ref.n_iterations)
+
+    def test_pallas_bounds_shapes(self):
+        """Per-pixel (n_pix, p) bounds must clip identically on both
+        paths (the row layout transposes them), and unsupported ranks
+        must fail with a CLEAR error, not a while_loop carry-shape one."""
+        import jax.numpy as jnp
+
+        from kafka_tpu.core.solvers import assimilate_date_jit
+        from kafka_tpu.testing.synthetic import make_tip_problem
+
+        op, bands, x0, p_inv0 = make_tip_problem(256)
+        n_pix, p = x0.shape
+        lo2d = jnp.broadcast_to(
+            jnp.asarray(op.state_bounds[0]), (n_pix, p)
+        )
+        hi2d = jnp.broadcast_to(
+            jnp.asarray(op.state_bounds[1]), (n_pix, p)
+        )
+        x_ref, _, d_ref = assimilate_date_jit(
+            op.linearize, bands, x0, p_inv0, None,
+            {"state_bounds": (lo2d, hi2d)},
+        )
+        x_pl, _, d_pl = assimilate_date_jit(
+            op.linearize, bands, x0, p_inv0, None,
+            {"state_bounds": (lo2d, hi2d), "use_pallas": True},
+        )
+        np.testing.assert_allclose(
+            np.asarray(x_pl), np.asarray(x_ref), atol=2e-3
+        )
+        assert int(d_pl.n_iterations) == int(d_ref.n_iterations)
+        with pytest.raises(ValueError, match="state_bounds"):
+            assimilate_date_jit(
+                op.linearize, bands, x0, p_inv0, None,
+                {"state_bounds": (lo2d[..., None], hi2d[..., None]),
+                 "use_pallas": True},
+            )
 
 
 class TestPerPixelConvergence:

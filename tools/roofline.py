@@ -8,14 +8,16 @@ the *chip* can do?  For each component of the hot path it reports:
   tunneled client defers execution and poisons dispatch latency, so
   naive timings are fiction; see BASELINE.md methodology),
 - XLA's post-fusion cost model (``compiled.cost_analysis()``): HBM bytes
-  accessed + flops of the optimised HLO,
-- achieved GB/s and GFLOP/s derived from the two,
-- percent of the v5e's public roofs: 819 GB/s HBM bandwidth and
-  197 TFLOP/s bf16 MXU peak (the packed path is float32 VPU work, so
-  the bandwidth roof is the binding one — flops are reported to show
-  the arithmetic intensity, not as a utilisation claim),
+  accessed + flops of the optimised HLO.  NOTE the cost model counts one
+  logical array once PER FUSION that touches it, so its byte totals are
+  an inefficiency signal (traffic amplification), NOT achieved bandwidth
+  — deriving utilisation from them produced impossible >100%-of-roof
+  numbers in earlier rounds,
 - the *analytic minimum* HBM traffic (read every live input once, write
-  every output once) as the fusion-perfect lower bound.
+  every output once) and the utilisation LOWER BOUND it implies against
+  the v5e's public roofs (819 GB/s HBM, 197 TFLOP/s bf16 MXU — the
+  packed path is float32 VPU work, so bandwidth is the binding roof;
+  flops show arithmetic intensity, not a utilisation claim).
 
 Components, at n = 2^19 pixels (the benchmark operating size):
 
@@ -65,7 +67,13 @@ def slope_time(fn, flush, k1=5, k2=25, reps=5, target_s=1.5):
 
 
 def cost_of(compiled):
-    ca = compiled.cost_analysis()
+    # The cost model has no entry for custom-call HLO (the Pallas kernel
+    # lowers to one) and some backends raise instead of skipping — NaN
+    # keeps the measured-ms row while dropping the model-derived columns.
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return float("nan"), float("nan")
     if isinstance(ca, (list, tuple)):
         ca = ca[0]
     return float(ca.get("bytes accessed", float("nan"))), float(
@@ -93,30 +101,46 @@ def measure(name, jitted, args, flush_leaf, rows, min_traffic=None,
     out = jitted(*args)  # warm
     flush_leaf(out)
     dt, spread = slope_time(lambda: jitted(*args), flush_leaf)
+    # Utilisation is derived from the ANALYTIC minimum traffic (live
+    # inputs read once, outputs written once), never from the XLA cost
+    # model: ``cost_analysis()`` sums per-fusion byte accounting in which
+    # one logical array read by N fusions counts N times, so cost-model
+    # "achieved GB/s" exceeded the physical HBM roof (>100% reported in
+    # rounds 4-5 — impossible numbers).  min_traffic/dt is a true LOWER
+    # bound on achieved bandwidth; the cost-model bytes stay in the row
+    # as the fusion-inefficiency signal they actually are (their ratio
+    # to min_traffic ~= how many times XLA re-touches each byte).
     row = {
         "component": name,
         "ms": dt * 1e3,
         "ms_spread": spread * 1e3,
         "xla_bytes": xla_bytes,
         "xla_flops": xla_flops,
-        "achieved_gbps": xla_bytes / dt / 1e9,
         "achieved_gflops": xla_flops / dt / 1e9,
-        "pct_hbm_roof": 100.0 * (xla_bytes / dt / 1e9) / HBM_GBPS,
         "min_traffic_bytes": min_traffic,
         "note": note,
     }
+    pct = ""
     if min_traffic:
+        row["min_traffic_gbps"] = min_traffic / dt / 1e9
+        row["pct_hbm_roof_lower_bound"] = (
+            100.0 * row["min_traffic_gbps"] / HBM_GBPS
+        )
+        row["traffic_amplification_xla"] = xla_bytes / min_traffic
         # Time the kernel would take if it only moved the live inputs and
         # outputs once at the full bandwidth roof.
         row["fusion_perfect_ms"] = min_traffic / (HBM_GBPS * 1e9) * 1e3
-    rows.append(row)
+        pct = (
+            f"-> >= {row['min_traffic_gbps']:6.1f} GB/s "
+            f">= {row['pct_hbm_roof_lower_bound']:.1f}% of HBM roof, "
+            f"{row['traffic_amplification_xla']:.1f}x cost-model traffic"
+        )
     print(
         f"{name:24s} {dt*1e3:8.2f} ms  (spread {spread*1e3:.2f})  "
-        f"XLA {xla_bytes/1e6:8.1f} MB  {xla_flops/1e9:7.2f} GFLOP  "
-        f"-> {row['achieved_gbps']:6.1f} GB/s "
-        f"({row['pct_hbm_roof']:.1f}% of HBM roof)",
+        f"XLA {xla_bytes/1e6:8.1f} MB  {xla_flops/1e9:7.2f} GFLOP  {pct}",
         file=sys.stderr,
     )
+    rows.append(row)
     return row
 
 
@@ -182,6 +206,25 @@ def tip_components(n_pix, rows):
         note=f"{n_iters} GN iterations (lax.while_loop)",
     )
     row["n_iterations"] = n_iters
+
+    # -- the same full GN loop on the fused Pallas path (use_pallas):
+    # the BASELINE.md "Roofline" pair.  Real-chip only — the CPU
+    # interpreter times the Pallas interpreter, not the kernel.
+    if jax.default_backend() == "tpu":
+        row_pl = measure(
+            "tip/gn_full_pallas",
+            _full_jit(op, {**opts, "use_pallas": True}),
+            (bands, x0, p_inv0),
+            lambda o: np.asarray(o[0][:1, 0]), rows, min_full,
+            note=f"{n_iters} GN iterations, fused VMEM-resident kernel",
+        )
+        row_pl["n_iterations"] = n_iters
+    else:
+        print(
+            "tip/gn_full_pallas       skipped - no TPU (interpret-mode "
+            "timings measure the interpreter, not the kernel)",
+            file=sys.stderr,
+        )
     return rows
 
 
