@@ -31,7 +31,12 @@ import time
 import numpy as np
 
 # ---------------------------------------------------------------------------
-# Bench health layer.
+# Bench health layer — PR 2 moved the probes into the shared telemetry
+# subsystem (``kafka_tpu.telemetry.health``): every probe records its
+# reading into the metrics registry and ``probe_health`` sources its
+# verdict FROM the registry, so the bench and production runs read the
+# same gauges.  The re-exports below keep the long-standing bench API
+# (``bench.probe_health`` etc.) and thresholds importable from here.
 #
 # The r03-r05 e2e rows swung 35.7k / 72.8k / 44.0k px-steps/s with NO code
 # change — tunnel congestion and host load, not the software under test.
@@ -40,112 +45,15 @@ import numpy as np
 # flagged instead of silently archived as a regression (or an improvement).
 # ---------------------------------------------------------------------------
 
-# Queued-device-rate reference: the XLA GN solve at 2^19 px measures
-# ~6.4 ms on a healthy v5e window (BASELINE.md "Roofline", held +-1%
-# across rounds 3-5).  A probe outside +-60% of that means the tunnel or
-# chip is not in its healthy regime.
-HEALTHY_DEVICE_MS = 6.4
-DEVICE_BAND = (0.4, 1.6)
-# Host probe: a 256x256 float32 matmul medians ~0.27 ms on this bench
-# host when idle; >1.0 ms means the (one-core) host is sharing cycles
-# with something else and every e2e row is suspect.
-HEALTHY_HOST_MS = 1.0
-
-
-def probe_host(reps: int = 9) -> float:
-    """Median ms of a fixed host-side CPU workload (256^2 f32 matmul)."""
-    a = np.random.default_rng(0).normal(size=(256, 256)).astype(np.float32)
-    a @ a  # warm the BLAS thread pool / caches out of the measurement
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        a @ a
-        times.append(time.perf_counter() - t0)
-    return float(np.median(times)) * 1e3
-
-
-def probe_device(n_pix: int = 1 << 19, ks=(5, 25), reps: int = 3) -> float:
-    """Queued-slope ms/solve of the standard XLA GN solve at the bench
-    operating size — the quantity whose healthy value (~6.4 ms on v5e)
-    BASELINE.md pins.  Same methodology as ``bench_device_sizes`` but
-    with fixed k's: a probe must be cheap, and at 2^19 px the per-solve
-    work already dominates the flush round-trip."""
-    import jax
-    import jax.numpy as jnp
-
-    from kafka_tpu.core.solvers import assimilate_date_jit
-    from kafka_tpu.testing.synthetic import make_tip_problem
-
-    op, bands, x0, p_inv0 = make_tip_problem(n_pix)
-    opts = {"state_bounds": (
-        jnp.asarray(op.state_bounds[0]), jnp.asarray(op.state_bounds[1])
-    )}
-    args = (op.linearize, bands, x0, p_inv0, None, opts)
-    x, _, _ = assimilate_date_jit(*args)
-    np.asarray(x[0][:1])
-
-    def run_k(k):
-        t0 = time.perf_counter()
-        for _ in range(k):
-            r, _, _ = assimilate_date_jit(*args)
-        np.asarray(r[0][:1])
-        return time.perf_counter() - t0
-
-    k1, k2 = ks
-    slopes = [(run_k(k2) - run_k(k1)) / (k2 - k1) for _ in range(reps)]
-    return float(np.median(slopes)) * 1e3
-
-
-def probe_health(retry_wait_s: float = 15.0) -> dict:
-    """Probe the two noise sources; retry once on an off-band reading.
-
-    Returns ``{"probe_device_ms", "probe_host_ms", "probe_retried",
-    "unhealthy", "unhealthy_reasons"}``.  The device band only applies on
-    a real TPU (interpret/CPU timings measure the interpreter, not the
-    chip); the host band always applies.  ``unhealthy`` travels into the
-    BENCH JSON so cross-round consumers can discard contaminated rows
-    instead of reading them as perf changes."""
-    import jax
-
-    on_tpu = jax.default_backend() == "tpu"
-
-    def read():
-        reasons = []
-        host_ms = probe_host()
-        if host_ms > HEALTHY_HOST_MS:
-            reasons.append(
-                f"host probe {host_ms:.2f} ms > {HEALTHY_HOST_MS} ms"
-            )
-        device_ms = None
-        if on_tpu:
-            device_ms = probe_device()
-            lo, hi = (HEALTHY_DEVICE_MS * b for b in DEVICE_BAND)
-            if not lo <= device_ms <= hi:
-                reasons.append(
-                    f"device probe {device_ms:.2f} ms outside "
-                    f"[{lo:.1f}, {hi:.1f}] ms"
-                )
-        return host_ms, device_ms, reasons
-
-    host_ms, device_ms, reasons = read()
-    retried = False
-    if reasons:
-        # Retry-or-flag: transient congestion (a test suite finishing, a
-        # tunnel hiccup) often clears within seconds; a persistent reading
-        # is real weather and the run is flagged, not silently trusted.
-        print(f"bench health: {'; '.join(reasons)} — retrying in "
-              f"{retry_wait_s:.0f}s", file=sys.stderr)
-        time.sleep(retry_wait_s)
-        host_ms, device_ms, reasons = read()
-        retried = True
-    return {
-        "probe_device_ms": None if device_ms is None
-        else round(device_ms, 3),
-        "probe_host_ms": round(host_ms, 3),
-        "probe_retried": retried,
-        "unhealthy": bool(reasons),
-        "unhealthy_reasons": reasons,
-    }
+from kafka_tpu.telemetry import get_registry
+from kafka_tpu.telemetry.health import (  # noqa: F401 — bench API re-export
+    DEVICE_BAND,
+    HEALTHY_DEVICE_MS,
+    HEALTHY_HOST_MS,
+    probe_device,
+    probe_health,
+    probe_host,
+)
 
 
 def bench_device_sizes(sizes, ks=(5, 25), use_pallas=False):
@@ -388,50 +296,40 @@ def bench_end_to_end(ny: int = 204, nx: int = 235, n_dates: int = 3,
             shutil.rmtree(tmp, ignore_errors=True)
 
 
-def main():
-    import jax
+def assemble_result(
+    health: dict,
+    *,
+    oracle,                # (px_s, ms_median, ms_spread) @ n_matched
+    device_matched,        # (px_s, ms_median, ms_spread) @ n_matched
+    device,                # (px_s, ms_median, ms_spread) @ n_device
+    pallas,                # same triple or None (off-TPU)
+    e2e,                   # (px_steps_s, device_fraction, n_pixels)
+    host_after_ms: float,
+    n_matched: int = 16384,
+    n_device: int = 1 << 19,
+    registry=None,
+) -> dict:
+    """Assemble the one-line BENCH JSON from measured rows.
 
-    from kafka_tpu.utils.compilation_cache import enable_compilation_cache
-
-    enable_compilation_cache()
-    # Health first: an off-band tunnel/host window contaminates every row
-    # below; probe (with one retry) BEFORE spending minutes measuring.
-    health = probe_health()
-    # Baseline on the reference's chunk size (16384 px = one 128x128
-    # chunk).  vs_baseline compares both backends at that SAME size so it
-    # measures the backend, not batch scaling; the headline value is the
-    # device's full-tile-scale throughput (its realistic operating point),
-    # with both sizes reported.
-    n_matched = 16384
-    n_device = 1 << 19
-    base_px_s, oracle_ms, oracle_spread_ms = bench_oracle(n_matched)
-    # The matched size measures in two bursts bracketing the large-size
-    # run: the tunnel's per-dispatch overhead drifts at minute scale, and
-    # the pooled median (+ reported spread) bounds that drift's effect
-    # on the headline speedup.
-    dev = bench_device_sizes([n_matched, n_device, n_matched])
-    dev_matched_px_s, matched_ms, matched_spread_ms = dev[n_matched]
-    dev_px_s, xla_ms, xla_spread_ms = dev[n_device]
-    # The fused-Pallas row, first-class next to the XLA one.  Real-chip
-    # only: the CPU interpreter times the Pallas INTERPRETER, not the
-    # kernel, and archiving that as a perf row would be fiction.
-    pallas_px_s = pallas_ms = pallas_spread_ms = None
-    if jax.default_backend() == "tpu":
-        dev_pl = bench_device_sizes([n_device], use_pallas=True)
-        pallas_px_s, pallas_ms, pallas_spread_ms = dev_pl[n_device]
-    else:
-        print(
-            "device[pallas]: skipped — no TPU (interpret-mode timings "
-            "measure the interpreter, not the kernel)",
-            file=sys.stderr,
-        )
-    e2e_px_steps_s, device_frac, e2e_pix = bench_end_to_end()
+    Split out of ``main`` so the off-TPU schema smoke test
+    (tests/test_bench_schema.py) exercises the EXACT artifact-assembly
+    path — key set, null conventions, health fields — without paying for
+    the measurements.  The health fields keep the PR 1 schema unchanged;
+    ``telemetry`` embeds the registry's compact counter/gauge snapshot
+    (including the health gauges the probes recorded).
+    """
+    base_px_s, oracle_ms, oracle_spread_ms = oracle
+    dev_matched_px_s, matched_ms, matched_spread_ms = device_matched
+    dev_px_s, xla_ms, xla_spread_ms = device
+    pallas_px_s, pallas_ms, pallas_spread_ms = \
+        pallas if pallas is not None else (None, None, None)
+    e2e_px_steps_s, device_frac, e2e_pix = e2e
+    reg = registry if registry is not None else get_registry()
     # Close the health bracket: a window that degraded DURING the run is
     # as contaminating as one that started bad (r03-r05 e2e noise).
-    host_after_ms = probe_host()
     unhealthy = bool(health["unhealthy"]) or \
         host_after_ms > HEALTHY_HOST_MS
-    print(json.dumps({
+    return {
         "metric": "assimilation_throughput",
         "value": round(dev_px_s, 1),
         "unit": "pixels/sec",
@@ -466,13 +364,67 @@ def main():
         "e2e_pixel_steps_per_s": round(e2e_px_steps_s, 1),
         "e2e_device_fraction": round(device_frac, 3),
         "e2e_n_pixels": e2e_pix,
-        # Bench health layer (see probe_health): off-band probes flag the
-        # whole artifact so cross-round consumers discard it instead of
-        # reading environment weather as a perf change.
+        # Bench health layer (see telemetry.health.probe_health): off-band
+        # probes flag the whole artifact so cross-round consumers discard
+        # it instead of reading environment weather as a perf change.
         **health,
         "probe_host_after_ms": round(host_after_ms, 3),
         "unhealthy": unhealthy,
-    }))
+        # Compact registry snapshot: counters/gauges (+histogram
+        # count/sum) from the run — convergence, prefetch, io and the
+        # health gauges the probes recorded.
+        "telemetry": reg.flat(),
+    }
+
+
+def main():
+    import jax
+
+    from kafka_tpu.utils.compilation_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    # Health first: an off-band tunnel/host window contaminates every row
+    # below; probe (with one retry) BEFORE spending minutes measuring.
+    health = probe_health()
+    # Baseline on the reference's chunk size (16384 px = one 128x128
+    # chunk).  vs_baseline compares both backends at that SAME size so it
+    # measures the backend, not batch scaling; the headline value is the
+    # device's full-tile-scale throughput (its realistic operating point),
+    # with both sizes reported.
+    n_matched = 16384
+    n_device = 1 << 19
+    base_px_s, oracle_ms, oracle_spread_ms = bench_oracle(n_matched)
+    # The matched size measures in two bursts bracketing the large-size
+    # run: the tunnel's per-dispatch overhead drifts at minute scale, and
+    # the pooled median (+ reported spread) bounds that drift's effect
+    # on the headline speedup.
+    dev = bench_device_sizes([n_matched, n_device, n_matched])
+    # The fused-Pallas row, first-class next to the XLA one.  Real-chip
+    # only: the CPU interpreter times the Pallas INTERPRETER, not the
+    # kernel, and archiving that as a perf row would be fiction.
+    pallas = None
+    if jax.default_backend() == "tpu":
+        dev_pl = bench_device_sizes([n_device], use_pallas=True)
+        pallas = dev_pl[n_device]
+    else:
+        print(
+            "device[pallas]: skipped — no TPU (interpret-mode timings "
+            "measure the interpreter, not the kernel)",
+            file=sys.stderr,
+        )
+    e2e = bench_end_to_end()
+    host_after_ms = probe_host()
+    print(json.dumps(assemble_result(
+        health,
+        oracle=(base_px_s, oracle_ms, oracle_spread_ms),
+        device_matched=dev[n_matched],
+        device=dev[n_device],
+        pallas=pallas,
+        e2e=e2e,
+        host_after_ms=host_after_ms,
+        n_matched=n_matched,
+        n_device=n_device,
+    )))
 
 
 if __name__ == "__main__":

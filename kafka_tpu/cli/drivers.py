@@ -471,9 +471,12 @@ def run_config(
     reference driver, including the dask fan-out (serial loop and
     distributed execution are the same code path here;
     ``kafka_test_S2.py:196-205`` vs ``kafka_test_Py36.py:242-255``)."""
+    from ..telemetry import configure, get_registry
     from ..utils.compilation_cache import enable_compilation_cache
 
     enable_compilation_cache()
+    if cfg.telemetry_dir:
+        configure(cfg.telemetry_dir)
     full_mask, geo = load_state_mask(cfg)
     ny, nx = full_mask.shape
     chunks = list(get_chunks(nx, ny, tuple(cfg.chunk_size)))
@@ -500,4 +503,8 @@ def run_config(
     stats["dates_assimilated"] = int(
         sum(s["n_dates_assimilated"] for s in summaries)
     )
+    reg = get_registry()
+    reg.emit("run_done", **stats)
+    # Snapshot the run's metrics (no-op when no telemetry_dir configured).
+    reg.dump()
     return stats

@@ -23,7 +23,7 @@ import logging
 
 from ..engine.config import RunConfig
 from ..engine.priors import JOINT_PARAMETER_LIST
-from . import make_console
+from . import add_telemetry_arg, make_console
 from .drivers import prosail_aux_builder, run_config
 
 
@@ -66,6 +66,7 @@ def main(argv=None):
     ap.add_argument("--s1-folder", default=None, help="S1 NetCDF folder")
     ap.add_argument("--state-mask", default=None)
     ap.add_argument("--outdir", default=None)
+    add_telemetry_arg(ap)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     logging.basicConfig(
@@ -81,6 +82,8 @@ def main(argv=None):
         cfg.state_mask = args.state_mask
     if args.outdir:
         cfg.output_folder = args.outdir
+    if args.telemetry_dir:
+        cfg.telemetry_dir = args.telemetry_dir
     if "s1_folder" not in cfg.extra:
         ap.error("--s1-folder (or extra.s1_folder in --config) is required")
 

@@ -23,7 +23,7 @@ import logging
 
 from ..engine.config import RunConfig
 from ..engine.priors import KERNEL_PARAMETER_LIST
-from . import make_console
+from . import add_telemetry_arg, make_console
 from .drivers import run_config
 
 
@@ -50,6 +50,7 @@ def main(argv=None):
     ap.add_argument("--data-folder", default=None)
     ap.add_argument("--state-mask", default=None)
     ap.add_argument("--outdir", default=None)
+    add_telemetry_arg(ap)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     logging.basicConfig(
@@ -63,6 +64,8 @@ def main(argv=None):
         cfg.state_mask = args.state_mask
     if args.outdir:
         cfg.output_folder = args.outdir
+    if args.telemetry_dir:
+        cfg.telemetry_dir = args.telemetry_dir
 
     stats = run_config(cfg)
     print(json.dumps(stats))

@@ -24,7 +24,7 @@ import logging
 
 from ..engine.config import RunConfig
 from ..engine.priors import TIP_PARAMETER_LIST
-from . import make_console
+from . import add_telemetry_arg, make_console
 from .drivers import run_config
 
 
@@ -57,6 +57,7 @@ def main(argv=None):
                     help="override jax.process_count() for the round-robin")
     ap.add_argument("--process-index", type=int, default=None,
                     help="override jax.process_index()")
+    add_telemetry_arg(ap)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     logging.basicConfig(
@@ -70,6 +71,8 @@ def main(argv=None):
         cfg.state_mask = args.state_mask
     if args.outdir:
         cfg.output_folder = args.outdir
+    if args.telemetry_dir:
+        cfg.telemetry_dir = args.telemetry_dir
 
     stats = run_config(
         cfg,

@@ -21,7 +21,7 @@ import logging
 
 from ..engine.config import RunConfig
 from ..engine.priors import PROSAIL_PARAMETER_LIST
-from . import make_console
+from . import add_telemetry_arg, make_console
 from .drivers import resolve_aux_builder, run_config
 
 
@@ -55,6 +55,7 @@ def main(argv=None):
                          "the assimilation through the reference's "
                          "emulator artifacts instead of the built-in "
                          "PROSAIL physics operator")
+    add_telemetry_arg(ap)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     logging.basicConfig(
@@ -68,6 +69,8 @@ def main(argv=None):
         cfg.state_mask = args.state_mask
     if args.outdir:
         cfg.output_folder = args.outdir
+    if args.telemetry_dir:
+        cfg.telemetry_dir = args.telemetry_dir
     if args.emulators:
         cfg.operator = "gp_bank"
         cfg.extra["emulator_folder"] = args.emulators

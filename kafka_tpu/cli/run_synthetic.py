@@ -30,7 +30,7 @@ from ..io import GeoTIFFOutput, read_geotiff
 from ..obsops import IdentityOperator, TwoStreamOperator, WCMAux, WCMOperator
 from ..testing.fixtures import DEFAULT_GEO, make_pivot_mask
 from ..testing.synthetic import SyntheticObservations
-from . import make_console
+from . import add_telemetry_arg, make_console
 
 import jax.numpy as jnp
 
@@ -105,12 +105,17 @@ def main(argv=None):
     ap.add_argument("--obs-every", type=int, default=2,
                     help="observation cadence in days")
     ap.add_argument("--checkpoint", action="store_true")
+    add_telemetry_arg(ap)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
 
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING
     )
+    from ..telemetry import configure, get_registry
+
+    if args.telemetry_dir:
+        configure(args.telemetry_dir)
     if args.mask:
         mask_arr, info = read_geotiff(args.mask)
         mask = mask_arr.astype(bool)
@@ -181,6 +186,9 @@ def main(argv=None):
                            for d in kf.diagnostics_log] or [0])), 2
         ),
     }
+    reg = get_registry()
+    reg.emit("run_done", **{k: v for k, v in summary.items()})
+    summary["telemetry_dir"] = reg.dump()
     print(json.dumps(summary))
     return summary
 

@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import functools
 import inspect
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -465,7 +465,7 @@ def iterated_solve(
         )
         return _finish_solve(
             x, a, fwd, innovations, n_done, norm, None, obs,
-            hessian_forward, operator_params,
+            hessian_forward, operator_params, state_bounds,
         )
 
     # Initial carry: no solves done yet; dummy A/h0/jac of the right shapes.
@@ -542,13 +542,47 @@ def iterated_solve(
     innovations = jnp.where(obs.mask, obs.y - h0, 0.0)
     return _finish_solve(
         x, a, fwd, innovations, n_done, norm, frozen, obs,
-        hessian_forward, operator_params,
+        hessian_forward, operator_params, state_bounds,
     )
+
+
+def _window_telemetry_scalars(x, innovations, obs, state_bounds):
+    """On-device per-window diagnostic scalars (telemetry subsystem).
+
+    Computed INSIDE the jitted solve so they join the packed diagnostic
+    read the engine already pays — zero additional device->host
+    transfers (see ``telemetry.device.fetch_scalars``).
+
+    - ``chi2``: (n_bands,) mean innovation chi^2 over each band's valid
+      pixels — sum(innov^2 * r_inv) / count(mask); ~1 when the assumed
+      observation uncertainty matches the residuals.
+    - ``clipped``: state entries exactly AT a bound on the final iterate
+      (the loop clips with these exact values, so equality identifies the
+      projected entries), counted over observed pixels only — padding
+      pixels sit at zero state and would otherwise read as clipped.
+    - ``nodata``: masked-out observation entries over all bands (padding
+      included; the engine subtracts its known padding).
+    """
+    count_b = jnp.sum(obs.mask, axis=1)
+    chi2 = jnp.sum(
+        innovations.astype(jnp.float32) ** 2 * obs.r_inv, axis=1
+    ) / jnp.maximum(count_b, 1).astype(jnp.float32)
+    nodata = jnp.sum(~obs.mask).astype(jnp.int32)
+    if state_bounds is None:
+        clipped = jnp.zeros((), jnp.int32)
+    else:
+        lo, hi = (jnp.asarray(v, jnp.float32) for v in state_bounds)
+        observed = jnp.any(obs.mask, axis=0)
+        at_bound = (x <= lo) | (x >= hi)
+        clipped = jnp.sum(
+            at_bound & observed[:, None]
+        ).astype(jnp.int32)
+    return chi2, clipped, nodata
 
 
 def _finish_solve(
     x, a, fwd, innovations, n_done, norm, frozen, obs,
-    hessian_forward, operator_params,
+    hessian_forward, operator_params, state_bounds=None,
 ):
     """Shared post-loop tail: optional second-order Hessian correction
     (with the PSD guard) + diagnostics packaging."""
@@ -577,12 +611,18 @@ def _finish_solve(
         # pixel); only off-cone pixels take the clamped rebuild.
         bad = w[..., 0] < floor[..., 0]
         a = jnp.where(bad[:, None, None], fixed, a)
+    chi2, clipped, nodata = _window_telemetry_scalars(
+        x, innovations, obs, state_bounds
+    )
     diags = SolveDiagnostics(
         innovations=innovations,
         fwd_modelled=fwd,
         n_iterations=n_done,
         convergence_norm=norm,
         converged_mask=frozen,
+        chi2_per_band=chi2,
+        clipped_count=clipped,
+        nodata_count=nodata,
     )
     return x, a, diags
 
@@ -766,6 +806,17 @@ def assimilate_date_jit(
     )
 
 
+class ScanWindowStats(NamedTuple):
+    """Per-window telemetry scalars stacked over a fused scan block —
+    computed on device inside each scan step (same quantities as the
+    trailing ``SolveDiagnostics`` fields) so the whole block's telemetry
+    rides the block's single packed device->host read."""
+
+    chi2_per_band: jnp.ndarray   # (K, n_bands)
+    clipped_count: jnp.ndarray   # (K,) int32
+    nodata_count: jnp.ndarray    # (K,) int32
+
+
 @functools.partial(jax.jit, static_argnums=(0, 9, 11, 12, 13, 14))
 def _assimilate_scan_impl(
     linearize: LinearizeFn,
@@ -809,6 +860,8 @@ def _assimilate_scan_impl(
         out = (
             x_n, batched_diagonal(p_inv_n),
             diags.n_iterations, diags.convergence_norm,
+            diags.chi2_per_band, diags.clipped_count,
+            diags.nodata_count,
         )
         # Per-pixel convergence masks stack along the window axis so the
         # fused path keeps the same per-pixel diagnostics as the unfused
@@ -821,8 +874,11 @@ def _assimilate_scan_impl(
         step, (x_analysis0, p_inv_analysis0), (obs_stacked, aux_stacked)
     )
     xs, diag_s, iters, norms = ys[:4]
-    converged = ys[4] if per_pixel_convergence else None
-    return x_fin, p_inv_fin, xs, diag_s, iters, norms, converged
+    stats = ScanWindowStats(
+        chi2_per_band=ys[4], clipped_count=ys[5], nodata_count=ys[6],
+    )
+    converged = ys[7] if per_pixel_convergence else None
+    return x_fin, p_inv_fin, xs, diag_s, iters, norms, converged, stats
 
 
 def assimilate_windows_scan(
@@ -856,9 +912,10 @@ def assimilate_windows_scan(
     whose prior declares ``date_invariant``.
 
     Returns ``(x_final, p_inv_final, xs (K, n, p), p_inv_diags (K, n, p),
-    n_iterations (K,), convergence_norms (K,), converged_masks)`` — the
-    last a ``(K, n)`` bool array under ``per_pixel_convergence``, else
-    None.
+    n_iterations (K,), convergence_norms (K,), converged_masks,
+    window_stats)`` — ``converged_masks`` a ``(K, n)`` bool array under
+    ``per_pixel_convergence`` (else None), ``window_stats`` a
+    :class:`ScanWindowStats` of stacked per-window telemetry scalars.
     """
     opts = dict(solver_options or {})
     block = opts.pop("linearize_block", None)

@@ -91,6 +91,11 @@ class SolveDiagnostics(NamedTuple):
     ``J (x_a - x_f) + H0`` (``solvers.py:70-71``); ``n_iterations`` and
     ``convergence_norm`` mirror the loop diagnostics of
     ``linear_kf.py:293-296``.
+
+    The trailing telemetry scalars are computed inside the jitted solve so
+    they ride the engine's one packed diagnostic device->host read per
+    window (``telemetry.device.fetch_scalars``) instead of costing extra
+    syncs.
     """
 
     innovations: jnp.ndarray
@@ -100,6 +105,18 @@ class SolveDiagnostics(NamedTuple):
     #: (n_pix,) bool — which pixels froze at a converged fixed point;
     #: only populated by ``per_pixel_convergence`` solves (else None).
     converged_mask: Any = None
+    #: (n_bands,) mean innovation chi^2 per band over that band's valid
+    #: pixels: sum(innov^2 * r_inv) / count(mask) — ~1 when the assumed
+    #: observation uncertainty matches the residuals.
+    chi2_per_band: Any = None
+    #: () int32 — state entries sitting exactly at a ``state_bounds``
+    #: limit on the final iterate, counted over observed pixels only
+    #: (padding/unobserved pixels excluded); 0 when no bounds were given.
+    clipped_count: Any = None
+    #: () int32 — masked-out (NaN/nodata) observation entries across all
+    #: bands, INCLUDING padding pixels (every band's mask is False there);
+    #: consumers with a PixelGather subtract n_bands * (n_pad - n_valid).
+    nodata_count: Any = None
 
 
 def flat_to_pixel_major(x_flat: jnp.ndarray, n_params: int) -> jnp.ndarray:

@@ -190,6 +190,11 @@ class RunConfig:
     #: values trade resume granularity for less write traffic on the
     #: annual-chain critical path
     checkpoint_every_n: int = 1
+    #: telemetry export directory (the drivers' ``--telemetry-dir``):
+    #: the structured event log streams to ``events.jsonl`` during the
+    #: run, ``metrics.prom`` / ``metrics.json`` snapshots land at run
+    #: end.  None = metrics stay in-memory only (zero files).
+    telemetry_dir: Optional[str] = None
     extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property

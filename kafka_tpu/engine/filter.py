@@ -31,7 +31,8 @@ from ..core.types import BandBatch
 from .prefetch import ObservationPrefetcher
 from .protocols import DateObservation, ObservationSource, OutputWriter, Prior
 from .state import PixelGather, make_pixel_gather
-from ..utils.profiling import annotate, trace
+from ..telemetry import fetch_scalars, get_registry, span
+from ..utils.profiling import trace
 
 LOG = logging.getLogger(__name__)
 
@@ -314,33 +315,99 @@ class KalmanFilter:
             p_a = None
             if self.diagnostics:
                 # One packed read: each device->host round-trip costs
-                # ~0.2 s of latency on a tunneled chip, so the
-                # diagnostic scalars travel together.
-                scalars = [
-                    jnp.asarray(diags.n_iterations, jnp.float32),
-                    jnp.asarray(diags.convergence_norm, jnp.float32),
+                # ~0.2 s of latency on a tunneled chip, so ALL diagnostic
+                # scalars — loop counters AND the telemetry quantities
+                # computed on device inside the solve — travel together
+                # through the counted fetch_scalars funnel.
+                n_bands = obs.bands.y.shape[0]
+                parts = [
+                    jnp.stack([
+                        jnp.asarray(diags.n_iterations, jnp.float32),
+                        jnp.asarray(diags.convergence_norm, jnp.float32),
+                        jnp.asarray(diags.clipped_count, jnp.float32),
+                        jnp.asarray(diags.nodata_count, jnp.float32),
+                    ]),
+                    jnp.asarray(diags.chi2_per_band, jnp.float32),
                 ]
                 if diags.converged_mask is not None:
-                    scalars.append(jnp.mean(
+                    parts.append(jnp.mean(
                         diags.converged_mask[: self.gather.n_valid]
                         .astype(jnp.float32)
-                    ))
-                packed = np.asarray(jnp.stack(scalars))
+                    )[None])
+                packed = fetch_scalars(jnp.concatenate(parts))
                 rec = {
                     "date": date,
                     "n_iterations": int(packed[0]),
                     "convergence_norm": float(packed[1]),
+                    "bounds_clipped": int(packed[2]),
+                    "nodata": self._nodata_valid(int(packed[3]), n_bands),
+                    "chi2_per_band": [
+                        float(v) for v in packed[4:4 + n_bands]
+                    ],
                     "wall_s": time.time() - t0,
                 }
                 if diags.converged_mask is not None:
-                    rec["converged_frac"] = float(packed[2])
+                    rec["converged_frac"] = float(packed[4 + n_bands])
                 self.diagnostics_log.append(rec)
+                self._record_window(rec)
                 LOG.info(
                     "Assimilated %s: %d iterations, norm %.3g, %.2fs",
                     date, rec["n_iterations"], rec["convergence_norm"],
                     rec["wall_s"],
                 )
         return x_a, p_a, p_inv_a
+
+    def _nodata_valid(self, raw: int, n_bands: int) -> int:
+        """Nodata count over REAL pixels: the device-side count includes
+        the padding rows (mask False in every band there)."""
+        pad = self.gather.n_pad - self.gather.n_valid
+        return max(0, raw - n_bands * pad)
+
+    def _record_window(self, rec: dict) -> None:
+        """Land one window's diagnostics in the telemetry registry + event
+        log.  Metric names: BASELINE.md "Observability"."""
+        reg = get_registry()
+        reg.counter(
+            "kafka_engine_windows_total",
+            "assimilated observation windows",
+        ).inc(mode="fused" if "fused" in rec else "single")
+        reg.histogram(
+            "kafka_engine_gn_iterations",
+            "Gauss-Newton iterations to convergence per window",
+            buckets=(1, 2, 3, 4, 6, 8, 12, 16, 25, 40),
+        ).observe(rec["n_iterations"])
+        reg.gauge(
+            "kafka_engine_convergence_norm",
+            "final Gauss-Newton step norm of the latest window",
+        ).set(rec["convergence_norm"])
+        chi2_hist = reg.histogram(
+            "kafka_engine_innovation_chi2",
+            "mean innovation chi^2 per band per window (~1 when the "
+            "assumed observation uncertainty matches residuals)",
+            buckets=(0.1, 0.25, 0.5, 0.75, 1.0, 1.5, 2.5, 5.0, 10.0,
+                     100.0),
+        )
+        for b, v in enumerate(rec["chi2_per_band"]):
+            chi2_hist.observe(v, band=b)
+        reg.counter(
+            "kafka_engine_bounds_clipped_total",
+            "state entries projected onto state_bounds (observed "
+            "pixels only)",
+        ).inc(rec["bounds_clipped"])
+        reg.counter(
+            "kafka_engine_nodata_pixels_total",
+            "masked-out (NaN/nodata) observation entries across bands",
+        ).inc(rec["nodata"])
+        if "converged_frac" in rec:
+            reg.gauge(
+                "kafka_engine_converged_frac",
+                "fraction of valid pixels frozen at convergence "
+                "(per_pixel_convergence mode)",
+            ).set(rec["converged_frac"])
+        reg.emit(
+            "solve",
+            **{k: (str(v) if k == "date" else v) for k, v in rec.items()},
+        )
 
     def _band_view(self, operator, band: int):
         from ..obsops.protocol import BandView, ObservationModel
@@ -380,6 +447,8 @@ class KalmanFilter:
         masks = []
         innovations = []
         fwds = []
+        chi2s = []
+        nodata_total = None
         last_diags = None
         for b in range(n_bands):
             band_obs = BandBatch(
@@ -398,8 +467,14 @@ class KalmanFilter:
             norms.append(last_diags.convergence_norm)
             innovations.append(last_diags.innovations)
             fwds.append(last_diags.fwd_modelled)
+            chi2s.append(last_diags.chi2_per_band)
+            nodata_total = last_diags.nodata_count if nodata_total is None \
+                else nodata_total + last_diags.nodata_count
             if last_diags.converged_mask is not None:
                 masks.append(last_diags.converged_mask)
+        # Telemetry merge: chi2 concatenates (each solve saw one band),
+        # nodata sums over bands, clipped is the LAST band's — the final
+        # state's bound projections (summing would re-count every loop).
         diags = last_diags._replace(
             n_iterations=iters_total,
             convergence_norm=jnp.max(jnp.stack(norms)),
@@ -408,6 +483,8 @@ class KalmanFilter:
             converged_mask=(
                 jnp.all(jnp.stack(masks), axis=0) if masks else None
             ),
+            chi2_per_band=jnp.concatenate(chi2s, axis=0),
+            nodata_count=nodata_total,
         )
         return x_a, p_inv_a, diags
 
@@ -675,7 +752,7 @@ class KalmanFilter:
                 first.operator, first.aux, stacked=aux_stacked,
                 batch_offset=1,
             )
-        x_fin, p_inv_fin, xs, diag_s, iters, norms, converged = (
+        x_fin, p_inv_fin, xs, diag_s, iters, norms, converged, wstats = (
             assimilate_windows_scan(
                 first.operator.linearize, bands, x_analysis, p_inv,
                 aux_stacked, self.trajectory_model,
@@ -684,7 +761,7 @@ class KalmanFilter:
             )
         )
         timesteps = [ts for ts, _ in block]
-        with annotate("kafka/dump"):
+        with span("dump"):
             dump_block = getattr(self.output, "dump_block", None)
             if dump_block is not None:
                 dump_block(timesteps, xs, diag_s, self.gather,
@@ -696,9 +773,16 @@ class KalmanFilter:
                         self.parameter_list,
                     )
         if self.diagnostics:
+            k = len(timesteps)
+            n_bands = first.bands.y.shape[0]
             scalars = [
                 jnp.asarray(iters, jnp.float32),
                 jnp.asarray(norms, jnp.float32),
+                jnp.asarray(wstats.clipped_count, jnp.float32),
+                jnp.asarray(wstats.nodata_count, jnp.float32),
+                jnp.asarray(
+                    wstats.chi2_per_band, jnp.float32
+                ).reshape(-1),
             ]
             if converged is not None:
                 # Fraction of VALID pixels frozen per window, computed
@@ -710,20 +794,32 @@ class KalmanFilter:
                         axis=1,
                     )
                 )
-            packed = np.asarray(jnp.concatenate(scalars))
-            k = len(timesteps)
+            packed = fetch_scalars(jnp.concatenate(scalars))
             wall = time.time() - t0
+            chi0 = 4 * k
             for j, ts in enumerate(timesteps):
                 rec = {
                     "date": ts,
                     "n_iterations": int(packed[j]),
                     "convergence_norm": float(packed[k + j]),
+                    "bounds_clipped": int(packed[2 * k + j]),
+                    "nodata": self._nodata_valid(
+                        int(packed[3 * k + j]), n_bands
+                    ),
+                    "chi2_per_band": [
+                        float(v) for v in
+                        packed[chi0 + j * n_bands:
+                               chi0 + (j + 1) * n_bands]
+                    ],
                     "wall_s": wall / k,
                     "fused": k,
                 }
                 if converged is not None:
-                    rec["converged_frac"] = float(packed[2 * k + j])
+                    rec["converged_frac"] = float(
+                        packed[chi0 + k * n_bands + j]
+                    )
                 self.diagnostics_log.append(rec)
+                self._record_window(rec)
             LOG.info(
                 "Assimilated %d fused windows ending %s in %.2fs",
                 k, timesteps[-1], wall,
@@ -783,7 +879,7 @@ class KalmanFilter:
                         "Advancing + assimilating %d fused windows "
                         "%s..%s", len(block), block[0][0], block[-1][0],
                     )
-                    with annotate("kafka/fused_scan"):
+                    with span("fused_scan"):
                         x_analysis, p_analysis, p_analysis_inverse = (
                             self._run_fused_block(
                                 block, x_analysis, p_analysis,
@@ -815,7 +911,7 @@ class KalmanFilter:
         )
         if (not is_first) or advance_first:
             LOG.info("Advancing state to %s", timestep)
-            with annotate("kafka/advance"):
+            with span("advance"):
                 x_forecast, p_forecast, p_forecast_inverse = (
                     self.advance(
                         x_analysis, p_analysis, p_analysis_inverse,
@@ -828,7 +924,7 @@ class KalmanFilter:
             p_analysis = p_forecast
             p_analysis_inverse = p_forecast_inverse
         else:
-            with annotate("kafka/assimilate"):
+            with span("assimilate"):
                 x_analysis, p_analysis, p_analysis_inverse = (
                     self.assimilate_dates(
                         locate_times, x_forecast, p_forecast,
@@ -838,7 +934,7 @@ class KalmanFilter:
         p_inv_diag = self._information_diagonal(
             p_analysis, p_analysis_inverse
         )
-        with annotate("kafka/dump"):
+        with span("dump"):
             # x/diag stay device arrays: an async writer then pays the
             # device->host transfer on its own thread, off the loop.
             self.output.dump_data(

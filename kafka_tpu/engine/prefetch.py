@@ -24,10 +24,12 @@ from __future__ import annotations
 import datetime
 import logging
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .protocols import DateObservation, ObservationSource
 from .state import PixelGather
+from ..telemetry import get_registry
 
 LOG = logging.getLogger(__name__)
 
@@ -73,6 +75,27 @@ class ObservationPrefetcher:
         self._next_claim = 0
         self._next_emit = 0
         self._stopped = threading.Event()
+        # Telemetry handles bound once (registry resolved at construction
+        # — the engine builds prefetchers after the driver's configure()).
+        reg = get_registry()
+        self._m_read = reg.histogram(
+            "kafka_prefetch_read_seconds",
+            "host-side read/decode/warp/gather seconds per date "
+            "(includes the optional transform, e.g. the mesh commit)",
+        )
+        self._m_wait = reg.histogram(
+            "kafka_prefetch_wait_seconds",
+            "seconds the engine loop blocked waiting for a prefetched "
+            "date (0 when the pipeline is ahead)",
+        )
+        self._m_reads = reg.counter(
+            "kafka_prefetch_reads_total",
+            "observation dates read by prefetch workers",
+        )
+        self._m_depth = reg.gauge(
+            "kafka_prefetch_queue_depth",
+            "prefetched dates buffered and not yet consumed",
+        )
         self._threads = [
             threading.Thread(
                 target=self._worker, name=f"obs-prefetch-{i}", daemon=True
@@ -93,6 +116,7 @@ class ObservationPrefetcher:
                     return
                 self._next_claim += 1
             date = self._dates[idx]
+            t0 = time.perf_counter()
             try:
                 obs = self._source.get_observations(date, self._gather)
                 if self._transform is not None:
@@ -100,8 +124,12 @@ class ObservationPrefetcher:
                 item = ("ok", obs)
             except BaseException as exc:  # re-raised at the caller's get()
                 item = ("error", exc)
+            if item[0] == "ok":
+                self._m_read.observe(time.perf_counter() - t0)
+                self._m_reads.inc()
             with self._cond:
                 self._results[idx] = item
+                self._m_depth.set(len(self._results))
                 if item[0] == "error":
                     # Don't claim past a failure: the run is about to
                     # abort at this date's get(); reading further dates
@@ -112,6 +140,7 @@ class ObservationPrefetcher:
                 return
 
     def get(self, date: datetime.datetime) -> DateObservation:
+        t0 = time.perf_counter()
         with self._cond:
             idx = self._next_emit
             while idx not in self._results and not self._stopped.is_set():
@@ -120,6 +149,8 @@ class ObservationPrefetcher:
                 raise RuntimeError("prefetcher closed while waiting")
             kind, payload = self._results.pop(idx)
             self._next_emit += 1
+            self._m_depth.set(len(self._results))
+        self._m_wait.observe(time.perf_counter() - t0)
         self._slots.release()
         if kind == "error":
             raise payload

@@ -15,11 +15,13 @@ import datetime
 import os
 import queue
 import threading
+import time
 from typing import Optional, Sequence
 
 import numpy as np
 
 from ..engine.state import PixelGather
+from ..telemetry import get_registry
 from .geotiff import GeoInfo, write_geotiff
 
 
@@ -72,6 +74,21 @@ class GeoTIFFOutput:
         self._queue: Optional[queue.Queue] = None
         self._worker: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+        reg = get_registry()
+        self._m_backlog = reg.gauge(
+            "kafka_io_writer_backlog",
+            "queued dump requests the async writer thread has not "
+            "finished (0 for synchronous writers)",
+        )
+        self._m_writes = reg.counter(
+            "kafka_io_writes_total",
+            "timesteps written to GeoTIFF outputs",
+        )
+        self._m_write_s = reg.histogram(
+            "kafka_io_write_seconds",
+            "wall seconds per timestep write (scatter + encode + disk, "
+            "all parameters)",
+        )
         if async_writes:
             self._queue = queue.Queue(maxsize=4)
             self._worker = threading.Thread(
@@ -91,26 +108,31 @@ class GeoTIFFOutput:
 
     def _write_all(self, timestep, x, unc, gather, parameter_list,
                    unc_is_sigma=False):
-        x = np.asarray(x)
-        for ii, param in enumerate(parameter_list):
-            raster = gather.scatter(x[:, ii].astype(np.float32))
-            write_geotiff(self._fname(param, timestep, False), raster,
-                          self.geo, predictor=self.predictor,
-                          level=self.level)
-        if unc is None:
-            return
-        unc = np.asarray(unc)
-        for ii, param in enumerate(parameter_list):
-            if unc_is_sigma:
-                sigma = unc[:, ii].astype(np.float32)
-            else:
-                sigma = 1.0 / np.sqrt(np.maximum(
-                    unc[:, ii].astype(np.float32), 1e-30
-                ))
-            raster = gather.scatter(sigma)
-            write_geotiff(self._fname(param, timestep, True), raster,
-                          self.geo, predictor=self.predictor,
-                          level=self.level)
+        t0 = time.perf_counter()
+        try:
+            x = np.asarray(x)
+            for ii, param in enumerate(parameter_list):
+                raster = gather.scatter(x[:, ii].astype(np.float32))
+                write_geotiff(self._fname(param, timestep, False), raster,
+                              self.geo, predictor=self.predictor,
+                              level=self.level)
+            if unc is None:
+                return
+            unc = np.asarray(unc)
+            for ii, param in enumerate(parameter_list):
+                if unc_is_sigma:
+                    sigma = unc[:, ii].astype(np.float32)
+                else:
+                    sigma = 1.0 / np.sqrt(np.maximum(
+                        unc[:, ii].astype(np.float32), 1e-30
+                    ))
+                raster = gather.scatter(sigma)
+                write_geotiff(self._fname(param, timestep, True), raster,
+                              self.geo, predictor=self.predictor,
+                              level=self.level)
+        finally:
+            self._m_writes.inc()
+            self._m_write_s.observe(time.perf_counter() - t0)
 
     def _to_wire(self, x, p_inv_diag):
         """Device-side downcast (and sigma computation) so the link moves
@@ -149,6 +171,7 @@ class GeoTIFFOutput:
                 (timestep, self._snapshot(x), self._snapshot(unc),
                  gather, tuple(parameter_list), unc_is_sigma)
             )
+            self._m_backlog.set(self._queue.qsize())
         else:
             self._write_all(timestep, x, unc, gather, parameter_list,
                             unc_is_sigma)
@@ -166,6 +189,7 @@ class GeoTIFFOutput:
         )
         if self._queue is not None:
             self._queue.put(("block",) + item)
+            self._m_backlog.set(self._queue.qsize())
         else:
             self._write_block(*item)
 
@@ -198,6 +222,7 @@ class GeoTIFFOutput:
             except Exception as exc:  # surfaced on next dump/flush/close
                 self._error = exc
             finally:
+                self._m_backlog.set(self._queue.qsize())
                 self._queue.task_done()
 
     def _raise_pending(self):

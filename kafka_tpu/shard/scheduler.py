@@ -15,13 +15,20 @@ replacement:
 - **restartability**: a per-chunk ``.done`` marker next to the outputs.
   ``pending_chunks`` skips completed work, so a restarted job (or a
   replacement host) re-runs only what's missing — strictly better than the
-  reference, which reruns every chunk the dead worker owned.
+  reference, which reruns every chunk the dead worker owned.  A chunk that
+  dies mid-run leaves NO marker, so a replacement process re-runs exactly
+  the missing chunks (tested in tests/test_shard.py).
+
+``run_chunks`` records completion counters, per-chunk wall-time histograms
+and straggler flags into the telemetry registry — the scheduler-level
+slice of the observability layer (BASELINE.md "Observability").
 """
 
 from __future__ import annotations
 
 import json
 import os
+import statistics
 import time
 from dataclasses import dataclass
 from typing import Callable, Iterable, List, Optional, Sequence
@@ -29,6 +36,14 @@ from typing import Callable, Iterable, List, Optional, Sequence
 import jax
 
 from ..io.tiling import Chunk
+from ..telemetry import get_registry
+
+#: a completed chunk is flagged a straggler when its wall time exceeds
+#: this multiple of the median of the chunks completed before it (with at
+#: least ``_STRAGGLER_MIN_SAMPLES`` priors) — the dask-dashboard signal
+#: the reference lost when it dropped dask, now a counter + event.
+STRAGGLER_FACTOR = 3.0
+_STRAGGLER_MIN_SAMPLES = 3
 
 
 @dataclass(frozen=True)
@@ -89,10 +104,50 @@ def run_chunks(
                                else jax.process_index())]),
              "run": 0, "skipped": 0, "wall_s": 0.0}
     stats["skipped"] = stats["assigned"] - len(todo)
+    reg = get_registry()
+    m_done = reg.counter(
+        "kafka_shard_chunks_completed_total",
+        "chunks run to completion (.done marker written)",
+    )
+    m_wall = reg.histogram(
+        "kafka_shard_chunk_seconds",
+        "wall seconds per completed chunk",
+    )
+    m_pending = reg.gauge(
+        "kafka_shard_chunks_pending",
+        "this process's chunks still to run",
+    )
+    m_straggle = reg.counter(
+        "kafka_shard_stragglers_total",
+        "completed chunks slower than STRAGGLER_FACTOR x the median of "
+        "prior completions",
+    )
+    m_pending.set(len(todo))
+    walls: List[float] = []
     t0 = time.time()
     for a in todo:
+        t_chunk = time.perf_counter()
         run_one(a.chunk, a.prefix)
-        mark_done(outdir, a.prefix, {"chunk": a.chunk.chunk_no})
+        wall = time.perf_counter() - t_chunk
+        mark_done(outdir, a.prefix, {"chunk": a.chunk.chunk_no,
+                                     "wall_s": round(wall, 3)})
         stats["run"] += 1
+        m_done.inc()
+        m_wall.observe(wall)
+        m_pending.set(len(todo) - stats["run"])
+        if len(walls) >= _STRAGGLER_MIN_SAMPLES:
+            median = statistics.median(walls)
+            if wall > STRAGGLER_FACTOR * median:
+                m_straggle.inc()
+                reg.emit(
+                    "straggler", prefix=a.prefix,
+                    chunk=a.chunk.chunk_no, wall_s=round(wall, 3),
+                    median_s=round(median, 3),
+                )
+        walls.append(wall)
+        reg.emit(
+            "chunk_done", prefix=a.prefix, chunk=a.chunk.chunk_no,
+            wall_s=round(wall, 3),
+        )
     stats["wall_s"] = time.time() - t0
     return stats

@@ -91,9 +91,10 @@ def make_sharded_step(
     return jax.jit(
         _step,
         in_shardings=(band_sh, px1, px2, rep, rep, px1, px2, None),
-        # Diagnostics: innovations/fwd are band-major pixel arrays, the two
-        # loop scalars are replicated; the per-pixel converged mask (only
-        # present under that convergence mode) rides the pixel axis.
+        # Diagnostics: innovations/fwd are band-major pixel arrays, the
+        # loop/telemetry scalars are replicated (chi2 is a tiny per-band
+        # vector); the per-pixel converged mask (only present under that
+        # convergence mode) rides the pixel axis.
         out_shardings=(
             px1, px2,
             SolveDiagnostics(
@@ -103,6 +104,7 @@ def make_sharded_step(
                     pixel_sharding(mesh, 0, 1)
                     if opts.get("per_pixel_convergence") else None
                 ),
+                chi2_per_band=rep, clipped_count=rep, nodata_count=rep,
             ),
         ),
     )

@@ -1,0 +1,39 @@
+"""First-class observability for the assimilation stack (SURVEY.md §5:
+the reference has none beyond timestamped DEBUG logging).
+
+Three layers, shared by the engine, the prefetch pipeline, the multi-host
+scheduler, the output writers, the CLI drivers and ``bench.py``:
+
+- :mod:`registry` — the thread-safe host-side metrics store (counters /
+  gauges / histograms with labels), JSONL event emission and
+  Prometheus-style text exposition;
+- :mod:`spans` — timed engine phases recorded in BOTH the registry and
+  ``jax.profiler`` traces;
+- :mod:`device` — the single funnel for packed diagnostic device->host
+  reads (zero-extra-transfer guarantee, counted);
+- :mod:`health` — the host/device health probes (grown out of bench.py),
+  readings sourced from the registry.
+
+See BASELINE.md "Observability" for metric names, label conventions and
+the event schema.
+"""
+
+from .device import fetch_scalars
+from .registry import (
+    MetricsRegistry,
+    configure,
+    get_registry,
+    set_registry,
+    use,
+)
+from .spans import span
+
+__all__ = [
+    "MetricsRegistry",
+    "configure",
+    "fetch_scalars",
+    "get_registry",
+    "set_registry",
+    "span",
+    "use",
+]

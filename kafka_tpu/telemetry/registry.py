@@ -1,0 +1,340 @@
+"""Host-side metrics registry — the observability layer the reference
+lacks entirely (SURVEY.md §5: timestamped DEBUG logging is its only
+instrumentation).
+
+One ``MetricsRegistry`` per process holds three metric kinds, all
+thread-safe and label-aware:
+
+- :class:`Counter` — monotonically increasing totals (windows assimilated,
+  pixels clipped, chunks completed);
+- :class:`Gauge` — last-written values (prefetch queue depth, writer
+  backlog, health probe readings);
+- :class:`Histogram` — bucketed distributions with sum/count/min/max
+  (phase wall-times, per-date read times, GN iteration counts).
+
+Two export surfaces:
+
+- **JSONL events** (``events.jsonl`` under the telemetry directory): every
+  ``emit()`` appends one ``{"ts", "event", ...}`` line — the structured
+  replacement for the reference's DEBUG log, greppable and loadable with
+  one ``json.loads`` per line.  A bounded in-memory ring keeps the tail
+  available to tests and crash handlers even with no directory configured.
+- **Prometheus text exposition** (``metrics.prom``): ``dump()`` writes the
+  standard ``name{label="v"} value`` format so a node-exporter textfile
+  collector (or any file scraper) picks a run up with zero extra infra,
+  plus ``metrics.json`` carrying the full :meth:`snapshot`.
+
+Metric names follow ``kafka_<subsystem>_<name>`` (see BASELINE.md
+"Observability"); ``tools/check_metric_names.py`` enforces the convention
+statically, so each name literal must appear at exactly one registration
+site.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import math
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+#: default histogram buckets (seconds-flavoured: spans ~1 ms .. ~2 min,
+#: which covers phase walls, reads and chunk runs alike).
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 120.0,
+)
+
+_NAME_RE = re.compile(r"^kafka_[a-z0-9]+_[a-z0-9_]+$")
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, Any]) -> LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _label_text(key: LabelKey) -> str:
+    if not key:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+
+
+class _Metric:
+    """Shared bookkeeping: one value slot per distinct label combination."""
+
+    kind = "metric"
+
+    def __init__(self, name: str, help: str, lock: threading.Lock):
+        self.name = name
+        self.help = help
+        self._lock = lock
+        self._values: Dict[LabelKey, Any] = {}
+
+    def value(self, **labels):
+        """Current value for this label combination (None if never set)."""
+        with self._lock:
+            return self._values.get(_label_key(labels))
+
+    def _series(self) -> List[Tuple[LabelKey, Any]]:
+        with self._lock:
+            return list(self._values.items())
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0) + amount
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = value
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help, lock, buckets: Sequence[float]):
+        super().__init__(name, help, lock)
+        self.buckets = tuple(sorted(buckets))
+
+    def observe(self, value: float, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            st = self._values.get(key)
+            if st is None:
+                st = self._values[key] = {
+                    "count": 0, "sum": 0.0,
+                    "min": math.inf, "max": -math.inf,
+                    "buckets": [0] * len(self.buckets),
+                }
+            st["count"] += 1
+            st["sum"] += float(value)
+            st["min"] = min(st["min"], float(value))
+            st["max"] = max(st["max"], float(value))
+            for i, le in enumerate(self.buckets):
+                if value <= le:
+                    st["buckets"][i] += 1
+
+
+class MetricsRegistry:
+    """Thread-safe metric store + structured event log.
+
+    ``directory`` (optional) roots the export files: events stream to
+    ``events.jsonl`` as they are emitted; ``dump()`` writes
+    ``metrics.prom`` and ``metrics.json`` snapshots.  Without a directory
+    everything stays in memory (metrics fully usable, events kept in the
+    ring only) so instrumented code needs no "is telemetry on" branches.
+    """
+
+    def __init__(self, directory: Optional[str] = None,
+                 max_events: int = 4096):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+        self.directory = directory
+        self.events: collections.deque = collections.deque(
+            maxlen=max_events
+        )
+        self._events_fh = None
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+            self._events_fh = open(
+                os.path.join(directory, "events.jsonl"), "a", buffering=1
+            )
+
+    # -- registration ---------------------------------------------------
+
+    def _get(self, cls, name: str, help: str, **kw) -> _Metric:
+        if not _NAME_RE.match(name):
+            raise ValueError(
+                f"metric name {name!r} does not follow the "
+                "kafka_<subsystem>_<name> convention"
+            )
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(
+                    name, help, threading.Lock(), **kw
+                )
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}"
+                )
+            elif help and not m.help:
+                m.help = help
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, help, buckets=buckets)
+
+    def metrics(self) -> List[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def value(self, name: str, **labels):
+        """Read one series' current value; None if absent — the accessor
+        the bench health layer and tests consume."""
+        with self._lock:
+            m = self._metrics.get(name)
+        return None if m is None else m.value(**labels)
+
+    # -- events ---------------------------------------------------------
+
+    def emit(self, event: str, **fields) -> None:
+        """Append one structured event (ring buffer + JSONL when a
+        directory is configured).  Values must be JSON-serialisable."""
+        rec = {"ts": round(time.time(), 6), "event": event, **fields}
+        self.events.append(rec)
+        fh = self._events_fh
+        if fh is not None:
+            try:
+                fh.write(json.dumps(rec, default=str) + "\n")
+            except ValueError:  # closed file during teardown
+                pass
+
+    # -- export ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Full nested snapshot: {name: {"type", "help", "series":
+        [{"labels": {...}, "value"|histogram-state}]}}."""
+        out: Dict[str, Any] = {}
+        for m in self.metrics():
+            series = []
+            for key, val in m._series():
+                entry: Dict[str, Any] = {"labels": dict(key)}
+                if m.kind == "histogram":
+                    entry.update({
+                        k: (None if isinstance(v, float)
+                            and math.isinf(v) else v)
+                        for k, v in val.items() if k != "buckets"
+                    })
+                else:
+                    entry["value"] = val
+                series.append(entry)
+            out[m.name] = {"type": m.kind, "help": m.help, "series": series}
+        return out
+
+    def flat(self) -> Dict[str, float]:
+        """Compact {name{labels}: value} view of counters and gauges (plus
+        histogram count/sum) — the form embedded in bench artifacts."""
+        out: Dict[str, float] = {}
+        for m in self.metrics():
+            for key, val in m._series():
+                tag = m.name + _label_text(key)
+                if m.kind == "histogram":
+                    out[tag + "_count"] = val["count"]
+                    out[tag + "_sum"] = round(val["sum"], 6)
+                else:
+                    out[tag] = val
+        return out
+
+    def prom_text(self) -> str:
+        """Prometheus text exposition format v0.0.4."""
+        lines: List[str] = []
+        for m in self.metrics():
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for key, val in m._series():
+                if m.kind == "histogram":
+                    for le, count in zip(m.buckets, val["buckets"]):
+                        k = key + (("le", f"{le:g}"),)
+                        lines.append(
+                            f"{m.name}_bucket{_label_text(k)} {count}"
+                        )
+                    inf_key = key + (("le", "+Inf"),)
+                    lines.append(
+                        f"{m.name}_bucket{_label_text(inf_key)} "
+                        f"{val['count']}"
+                    )
+                    lines.append(
+                        f"{m.name}_sum{_label_text(key)} {val['sum']:g}"
+                    )
+                    lines.append(
+                        f"{m.name}_count{_label_text(key)} {val['count']}"
+                    )
+                else:
+                    lines.append(f"{m.name}{_label_text(key)} {val:g}")
+        return "\n".join(lines) + "\n"
+
+    def dump(self, directory: Optional[str] = None) -> Optional[str]:
+        """Write ``metrics.prom`` + ``metrics.json`` into ``directory``
+        (default: the configured one).  Returns the directory or None when
+        there is nowhere to write."""
+        directory = directory or self.directory
+        if not directory:
+            return None
+        os.makedirs(directory, exist_ok=True)
+        with open(os.path.join(directory, "metrics.prom"), "w") as f:
+            f.write(self.prom_text())
+        with open(os.path.join(directory, "metrics.json"), "w") as f:
+            json.dump(self.snapshot(), f, indent=2, default=str)
+        return directory
+
+    def close(self) -> None:
+        if self._events_fh is not None:
+            self._events_fh.close()
+            self._events_fh = None
+
+
+# ---------------------------------------------------------------------------
+# Process-default registry.  Instrumented modules call ``get_registry()``
+# at record time, so ``configure()`` (CLI drivers) or ``use()`` (tests)
+# swap the sink without threading a registry through every constructor.
+# ---------------------------------------------------------------------------
+
+_default = MetricsRegistry()
+_default_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    return _default
+
+
+def set_registry(registry: MetricsRegistry) -> MetricsRegistry:
+    """Replace the process-default registry; returns the previous one."""
+    global _default
+    with _default_lock:
+        prev, _default = _default, registry
+    return prev
+
+
+def configure(directory: Optional[str]) -> MetricsRegistry:
+    """Point the process-default registry at ``directory`` (the CLI
+    drivers' ``--telemetry-dir``).  ``None`` resets to in-memory-only."""
+    return_to = MetricsRegistry(directory)
+    set_registry(return_to)
+    return return_to
+
+
+class use:
+    """Context manager: temporarily install ``registry`` as the default —
+    the test-isolation hook (``with use(MetricsRegistry()) as reg: ...``)."""
+
+    def __init__(self, registry: MetricsRegistry):
+        self.registry = registry
+        self._prev: Optional[MetricsRegistry] = None
+
+    def __enter__(self) -> MetricsRegistry:
+        self._prev = set_registry(self.registry)
+        return self.registry
+
+    def __exit__(self, *exc) -> None:
+        set_registry(self._prev)

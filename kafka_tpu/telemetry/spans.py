@@ -1,0 +1,43 @@
+"""Timed phase spans: one context manager that lands in BOTH sinks.
+
+``utils.profiling.annotate`` labels host work inside ``jax.profiler``
+traces (TensorBoard/Perfetto timelines); the registry records the same
+span as a wall-time histogram and a JSONL event.  The engine's phases
+(advance / assimilate / dump / fused_scan) use this so a run's phase
+breakdown is readable from the metrics snapshot without ever capturing a
+profiler trace — and when a trace IS captured, the two views carry the
+same names.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+from ..utils.profiling import annotate
+from .registry import MetricsRegistry, get_registry
+
+
+@contextlib.contextmanager
+def span(phase: str, registry: Optional[MetricsRegistry] = None,
+         **fields) -> Iterator[None]:
+    """Time the enclosed block as engine phase ``phase``.
+
+    Shows up as a ``kafka/<phase>`` TraceAnnotation in profiler traces, a
+    ``kafka_engine_phase_seconds{phase=...}`` histogram observation, and a
+    ``phase`` JSONL event (with any extra ``fields`` attached).
+    """
+    reg = registry if registry is not None else get_registry()
+    with annotate(f"kafka/{phase}"):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            reg.histogram(
+                "kafka_engine_phase_seconds",
+                "wall seconds per engine phase (advance/assimilate/"
+                "dump/fused_scan)",
+            ).observe(dt, phase=phase)
+            reg.emit("phase", phase=phase, seconds=round(dt, 6), **fields)

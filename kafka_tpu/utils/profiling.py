@@ -30,7 +30,11 @@ def trace(logdir: Optional[str]) -> Iterator[None]:
     try:
         import jax.profiler
         ctx = jax.profiler.trace(logdir)
-    except Exception:  # pragma: no cover - profiler unavailable
+    except (ImportError, AttributeError):
+        # Profiler genuinely unavailable (no jax / stripped build) — a
+        # host-only tool keeps working untraced.  Anything else (bad
+        # logdir, a second trace already active) is a REAL failure the
+        # caller asked for a trace and must hear about.
         yield
         return
     with ctx:
@@ -43,7 +47,9 @@ def annotate(name: str) -> Iterator[None]:
     try:
         import jax.profiler
         ctx = jax.profiler.TraceAnnotation(name)
-    except Exception:  # pragma: no cover - profiler unavailable
+    except (ImportError, AttributeError):
+        # Same contract as trace(): only "profiler unavailable" degrades
+        # to a no-op; real profiler failures surface.
         yield
         return
     with ctx:

@@ -1,0 +1,114 @@
+"""Off-TPU smoke test of the bench result-assembly path (ISSUE 2
+satellite): the BENCH JSON schema is consumed by cross-round dashboards,
+so drift must break tier-1 here, not the dashboard."""
+
+import json
+
+import pytest
+
+from kafka_tpu import telemetry
+from kafka_tpu.telemetry import MetricsRegistry
+
+import bench
+
+#: The PR 1 artifact key set (BENCH_r*.json), plus PR 2's "telemetry"
+#: snapshot.  Health fields byte-identical in schema to PR 1.
+EXPECTED_KEYS = [
+    "metric", "value", "unit",
+    "vs_baseline", "vs_baseline_at_scale",
+    "oracle_ms_median", "oracle_ms_spread",
+    "n_pix_device", "n_pix_matched",
+    "device_px_s_matched", "device_ms_matched_median",
+    "device_ms_matched_spread",
+    "device_xla_ms", "device_xla_ms_spread",
+    "device_pallas_ms", "device_pallas_ms_spread", "device_pallas_px_s",
+    "e2e_pixel_steps_per_s", "e2e_device_fraction", "e2e_n_pixels",
+    "probe_device_ms", "probe_host_ms", "probe_retried",
+    "unhealthy_reasons", "probe_host_after_ms", "unhealthy",
+    "telemetry",
+]
+
+HEALTH_KEYS = {
+    "probe_device_ms", "probe_host_ms", "probe_retried",
+    "unhealthy", "unhealthy_reasons",
+}
+
+
+def _assemble(reg, host_after_ms=0.3):
+    health = bench.probe_health(retry_wait_s=0.0, registry=reg)
+    return health, bench.assemble_result(
+        health,
+        oracle=(1.0e5, 160.0, 12.0),
+        device_matched=(2.0e6, 8.0, 0.5),
+        device=(8.2e7, 6.4, 0.05),
+        pallas=None,           # off-TPU: the Pallas row is never measured
+        e2e=(5.0e4, 0.55, 7212),
+        host_after_ms=host_after_ms,
+        registry=reg,
+    )
+
+
+class TestBenchArtifactSchema:
+    def test_key_set_matches_pr1_plus_telemetry(self):
+        with telemetry.use(MetricsRegistry()) as reg:
+            health, result = _assemble(reg)
+        assert set(result.keys()) == set(EXPECTED_KEYS)
+        # Health fields: schema byte-identical to the PR 1 artifact.
+        assert HEALTH_KEYS <= set(health.keys())
+        for k in HEALTH_KEYS:
+            assert result[k] == health[k] or k == "unhealthy"
+
+    def test_pallas_fields_null_off_tpu(self):
+        import jax
+
+        assert jax.default_backend() != "tpu"  # the suite pins CPU
+        with telemetry.use(MetricsRegistry()) as reg:
+            _, result = _assemble(reg)
+        assert result["device_pallas_ms"] is None
+        assert result["device_pallas_ms_spread"] is None
+        assert result["device_pallas_px_s"] is None
+        assert result["probe_device_ms"] is None
+
+    def test_telemetry_snapshot_carries_health_gauges(self):
+        """probe_health records into — and reads back from — the
+        registry: the bench artifact's telemetry snapshot must carry the
+        exact probe reading the health verdict was made from."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            _, result = _assemble(reg)
+            host_gauge = reg.value("kafka_health_probe_host_ms")
+        tel = result["telemetry"]
+        assert tel["kafka_health_probe_host_ms"] == host_gauge
+        assert round(host_gauge, 3) == result["probe_host_ms"]
+        assert "kafka_health_unhealthy" in tel
+
+    def test_json_serialisable_one_line(self):
+        with telemetry.use(MetricsRegistry()) as reg:
+            _, result = _assemble(reg)
+        line = json.dumps(result)
+        assert "\n" not in line
+        assert json.loads(line)["metric"] == "assimilation_throughput"
+
+    def test_unhealthy_flag_closes_the_bracket(self):
+        """A host that degraded DURING the run flags the artifact even
+        when the opening probe was healthy."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            health = bench.probe_health(retry_wait_s=0.0, registry=reg)
+            result = bench.assemble_result(
+                health,
+                oracle=(1.0e5, 160.0, 12.0),
+                device_matched=(2.0e6, 8.0, 0.5),
+                device=(8.2e7, 6.4, 0.05),
+                pallas=None,
+                e2e=(5.0e4, 0.55, 7212),
+                host_after_ms=bench.HEALTHY_HOST_MS * 10,
+                registry=reg,
+            )
+        assert result["unhealthy"] is True
+
+    def test_numbers_flow_through(self):
+        with telemetry.use(MetricsRegistry()) as reg:
+            _, result = _assemble(reg)
+        assert result["value"] == 8.2e7
+        assert result["vs_baseline"] == pytest.approx(20.0)
+        assert result["vs_baseline_at_scale"] == pytest.approx(820.0)
+        assert result["e2e_n_pixels"] == 7212

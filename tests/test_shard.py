@@ -107,6 +107,74 @@ def test_scheduler_round_robin_and_restart(tmp_path):
     assert pending_chunks(assign_chunks(chunks, 4), outdir, 2) == []
 
 
+def test_scheduler_crash_midway_reruns_exactly_missing(tmp_path):
+    """Restart path: a chunk run that dies mid-way leaves NO .done marker,
+    and ``pending_chunks`` on a replacement process re-runs exactly the
+    missing chunks — no repeats, no gaps."""
+    from kafka_tpu.shard.scheduler import marker_path
+
+    chunks = list(get_chunks(512, 512, (128, 128)))  # 16 chunks
+    outdir = str(tmp_path)
+    assignments = assign_chunks(chunks, num_processes=2)
+    mine = [a for a in assignments if a.owner == 0]
+    die_at = mine[3].prefix  # crash on this process's 4th chunk
+    ran = []
+
+    def run_one_dying(chunk, prefix):
+        if prefix == die_at:
+            raise RuntimeError("synthetic mid-chunk crash")
+        ran.append(prefix)
+
+    with pytest.raises(RuntimeError, match="mid-chunk crash"):
+        run_chunks(chunks, run_one_dying, outdir,
+                   num_processes=2, process_index=0)
+    # Completed chunks are durable, the crashed one left no marker.
+    assert len(ran) == 3
+    for p in ran:
+        assert os.path.exists(marker_path(outdir, p))
+    assert not os.path.exists(marker_path(outdir, die_at))
+    # A replacement process sees exactly the missing chunks, crashed one
+    # included, in the deterministic assignment order.
+    pending = pending_chunks(assign_chunks(chunks, 2), outdir, 0)
+    assert [a.prefix for a in pending] == \
+        [a.prefix for a in mine if a.prefix not in ran]
+    assert die_at in {a.prefix for a in pending}
+    # The rerun completes only the missing work; nothing repeats.
+    rerun = []
+    stats = run_chunks(chunks, lambda c, p: rerun.append(p), outdir,
+                       num_processes=2, process_index=0)
+    assert stats["skipped"] == 3 and stats["run"] == len(mine) - 3
+    assert set(rerun).isdisjoint(ran)
+    assert pending_chunks(assign_chunks(chunks, 2), outdir, 0) == []
+
+
+def test_scheduler_records_telemetry(tmp_path):
+    """Chunk completion + wall-time land in the registry; an outlier chunk
+    is flagged as a straggler (counter + event)."""
+    import time as _time
+
+    from kafka_tpu import telemetry
+
+    chunks = list(get_chunks(512, 256, (128, 128)))  # 8 chunks
+    # Stable ~10ms baseline so scheduler jitter can't fake a 3x outlier;
+    # the last chunk 'hangs' at >3x the median.
+    walls = iter([0.01] * 7 + [0.12])
+
+    def run_one(chunk, prefix):
+        _time.sleep(next(walls))
+
+    with telemetry.use(telemetry.MetricsRegistry()) as reg:
+        stats = run_chunks(chunks, run_one, str(tmp_path),
+                           num_processes=1, process_index=0)
+        assert stats["run"] == 8
+        assert reg.value("kafka_shard_chunks_completed_total") == 8
+        assert reg.value("kafka_shard_chunks_pending") == 0
+        assert reg.value("kafka_shard_stragglers_total") == 1
+        events = [e["event"] for e in reg.events]
+        assert events.count("chunk_done") == 8
+        assert events.count("straggler") == 1
+
+
 def test_fused_scan_composes_with_sharding(eight_cpu_devices):
     """Temporal fusion under GSPMD: assimilate_windows_scan on arrays
     sharded over the pixel mesh must run multi-device and agree with the
@@ -131,7 +199,7 @@ def test_fused_scan_composes_with_sharding(eight_cpu_devices):
     )
 
     # single device
-    _, _, xs_ref, diag_ref, iters_ref, _, _ = assimilate_windows_scan(
+    _, _, xs_ref, diag_ref, iters_ref, _, _, _ = assimilate_windows_scan(
         op.linearize, stacked, x0, pi0, None, m, q, None, None,
         propagate_information_filter, dict(opts), None,
     )
@@ -144,7 +212,8 @@ def test_fused_scan_composes_with_sharding(eight_cpu_devices):
         mask=jax.device_put(stacked.mask, band_sh),
     )
     xs0, ps0 = shard_state(mesh, x0, pi0)
-    x_fin, p_fin, xs_sh, diag_sh, iters_sh, _, _ = assimilate_windows_scan(
+    x_fin, p_fin, xs_sh, diag_sh, iters_sh, _, _, _ = \
+        assimilate_windows_scan(
         op.linearize, stacked_sh, xs0, ps0, None, m, q, None, None,
         propagate_information_filter, dict(opts), None,
     )

@@ -1,0 +1,114 @@
+"""Static metric-name lint for the telemetry subsystem.
+
+Greps every ``.counter("...")`` / ``.gauge("...")`` / ``.histogram("...")``
+registration in the production tree (``kafka_tpu/`` + ``bench.py``) and
+fails on:
+
+- a name not matching the documented ``kafka_<subsystem>_<name>``
+  convention (BASELINE.md "Observability");
+- the same name registered at more than one source location (each metric
+  has exactly ONE owner — duplicated literals drift apart silently);
+- the same name registered as two different kinds.
+
+Wired into tier-1 as ``tests/test_metric_names.py``, so a telemetry
+regression breaks the suite instead of the dashboard.
+
+Usage:
+    python tools/check_metric_names.py [repo_root]
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List, Tuple
+
+#: registration call with a literal first argument.
+REGISTRATION_RE = re.compile(
+    r"\.\s*(counter|gauge|histogram)\(\s*\n?\s*\"([^\"]+)\"", re.MULTILINE
+)
+NAME_RE = re.compile(r"^kafka_[a-z0-9]+_[a-z0-9_]+$")
+
+#: production sources scanned for registrations, relative to the root.
+SCAN = ("kafka_tpu", "bench.py")
+
+
+def iter_sources(root: str):
+    for entry in SCAN:
+        path = os.path.join(root, entry)
+        if os.path.isfile(path):
+            yield path
+        else:
+            for dirpath, _dirnames, filenames in os.walk(path):
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def collect_registrations(
+    root: str,
+) -> Dict[str, List[Tuple[str, int, str]]]:
+    """name -> [(relative_path, line, kind), ...] over the scanned tree."""
+    out: Dict[str, List[Tuple[str, int, str]]] = {}
+    for path in iter_sources(root):
+        with open(path) as f:
+            text = f.read()
+        for m in REGISTRATION_RE.finditer(text):
+            kind, name = m.group(1), m.group(2)
+            line = text.count("\n", 0, m.start()) + 1
+            rel = os.path.relpath(path, root)
+            out.setdefault(name, []).append((rel, line, kind))
+    return out
+
+
+def check(root: str) -> List[str]:
+    """All convention violations in ``root`` (empty list = clean)."""
+    errors: List[str] = []
+    regs = collect_registrations(root)
+    if not regs:
+        errors.append(
+            f"no metric registrations found under {root!r} — the scanner "
+            "or the telemetry wiring is broken"
+        )
+    for name, sites in sorted(regs.items()):
+        where = ", ".join(f"{p}:{ln}" for p, ln, _ in sites)
+        if not NAME_RE.match(name):
+            errors.append(
+                f"{name!r} ({where}) does not match "
+                "kafka_<subsystem>_<name>"
+            )
+        if len(sites) > 1:
+            errors.append(
+                f"{name!r} registered at {len(sites)} sites ({where}); "
+                "each metric must have exactly one owner"
+            )
+        kinds = {k for _, _, k in sites}
+        if len(kinds) > 1:
+            errors.append(
+                f"{name!r} registered as multiple kinds "
+                f"({sorted(kinds)}; {where})"
+            )
+    return errors
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    errors = check(root)
+    regs = collect_registrations(root)
+    if errors:
+        for e in errors:
+            print(f"check_metric_names: {e}", file=sys.stderr)
+        return 1
+    print(
+        f"check_metric_names: {len(regs)} metric names OK "
+        f"({sum(len(s) for s in regs.values())} registrations)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
