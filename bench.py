@@ -380,9 +380,24 @@ def assemble_result(
 def main():
     import jax
 
+    from kafka_tpu.telemetry import (
+        flight_recorder, install_compile_listeners, tracing,
+    )
     from kafka_tpu.utils.compilation_cache import enable_compilation_cache
 
     enable_compilation_cache()
+    install_compile_listeners()
+    # Crash forensics next to the BENCH artifact: a bench killed mid-run
+    # (or flagged unhealthy by the probes) leaves crash_<ts>.json in the
+    # working directory instead of nothing.
+    recorder = flight_recorder.install(".")
+    with tracing.push(run_id=tracing.new_run_id()), recorder:
+        _bench_rows()
+
+
+def _bench_rows():
+    import jax
+
     # Health first: an off-band tunnel/host window contaminates every row
     # below; probe (with one retry) BEFORE spending minutes measuring.
     health = probe_health()

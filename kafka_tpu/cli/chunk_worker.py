@@ -19,6 +19,7 @@ Usage (emitted by ``run_one_chunk_resilient`` — not user-facing):
 from __future__ import annotations
 
 import json
+import os
 import sys
 
 OOM_EXIT_CODE = 17
@@ -29,6 +30,10 @@ def main(argv=None) -> int:
     cfg_path, x0, y0, nx, ny, chunk_no, prefix = argv
     from ..engine.config import RunConfig
     from ..io.tiling import Chunk
+    from ..telemetry import (
+        configure, flight_recorder, get_registry,
+        install_compile_listeners, tracing,
+    )
     from .drivers import (
         _is_oom,
         load_state_mask,
@@ -38,19 +43,32 @@ def main(argv=None) -> int:
     from ..utils.compilation_cache import enable_compilation_cache
 
     enable_compilation_cache()
+    install_compile_listeners()
     cfg = RunConfig.load(cfg_path)
+    # Per-chunk telemetry subdirectory: this fresh process must not
+    # interleave its events/trace with the parent scheduler's files.
+    tel_dir = None
+    if cfg.telemetry_dir:
+        tel_dir = os.path.join(cfg.telemetry_dir, f"chunk_{prefix}")
+        configure(tel_dir)
+    recorder = flight_recorder.install(tel_dir)
     chunk = Chunk(int(x0), int(y0), int(nx), int(ny), int(chunk_no))
     full_mask, geo = load_state_mask(cfg)
-    try:
-        summary = run_one_chunk(
-            cfg, chunk, prefix, full_mask, geo,
-            resolve_aux_builder(cfg),
-        )
-    except Exception as exc:  # noqa: BLE001 — classified for the parent
-        if _is_oom(exc):
-            print(str(exc)[:500], file=sys.stderr)
-            return OOM_EXIT_CODE
-        raise
+    # new_run_id() picks up KAFKA_TPU_RUN_ID from the parent scheduler,
+    # so this worker's spans and crash dumps correlate with its trace.
+    with tracing.push(run_id=tracing.new_run_id(), chunk_id=prefix):
+        try:
+            with recorder:
+                summary = run_one_chunk(
+                    cfg, chunk, prefix, full_mask, geo,
+                    resolve_aux_builder(cfg),
+                )
+        except Exception as exc:  # noqa: BLE001 — classified for parent
+            if _is_oom(exc):
+                print(str(exc)[:500], file=sys.stderr)
+                return OOM_EXIT_CODE
+            raise
+    get_registry().dump()
     print(json.dumps(summary))
     return 0
 

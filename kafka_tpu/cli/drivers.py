@@ -316,13 +316,21 @@ def _run_chunk_subprocess(cfg: RunConfig, chunk, prefix: str):
             "chunk_worker_timeout", 4 * 3600
         )
     )
+    # Hand the run id down so the worker's spans/crash dumps correlate
+    # with this scheduler's trace (kafka_tpu.telemetry.tracing).
+    env = dict(os.environ)
+    from ..telemetry import tracing
+
+    ctx = tracing.current_context()
+    if ctx is not None:
+        env["KAFKA_TPU_RUN_ID"] = ctx.run_id
     try:
         proc = subprocess.run(
             [sys.executable, "-m", "kafka_tpu.cli.chunk_worker",
              cfg_path, str(chunk.x0), str(chunk.y0),
              str(chunk.nx_valid), str(chunk.ny_valid),
              str(chunk.chunk_no), prefix],
-            capture_output=True, text=True, timeout=timeout_s,
+            capture_output=True, text=True, timeout=timeout_s, env=env,
         )
     except subprocess.TimeoutExpired:
         LOG.error(
@@ -471,12 +479,20 @@ def run_config(
     reference driver, including the dask fan-out (serial loop and
     distributed execution are the same code path here;
     ``kafka_test_S2.py:196-205`` vs ``kafka_test_Py36.py:242-255``)."""
-    from ..telemetry import configure, get_registry
+    from ..telemetry import (
+        configure, flight_recorder, get_registry,
+        install_compile_listeners, tracing,
+    )
     from ..utils.compilation_cache import enable_compilation_cache
 
     enable_compilation_cache()
+    install_compile_listeners()
     if cfg.telemetry_dir:
         configure(cfg.telemetry_dir)
+    # Crash forensics: unhandled exceptions, SIGTERM/SIGINT and unhealthy
+    # probe verdicts dump crash_<ts>.json into the telemetry directory
+    # (no-op without one — see telemetry.flight_recorder).
+    recorder = flight_recorder.install(cfg.telemetry_dir)
     full_mask, geo = load_state_mask(cfg)
     ny, nx = full_mask.shape
     chunks = list(get_chunks(nx, ny, tuple(cfg.chunk_size)))
@@ -494,10 +510,13 @@ def run_config(
             summaries.append(s)
             LOG.info("chunk %s: %s", prefix, json.dumps(s))
 
-    stats = run_chunks(
-        chunks, run_one, cfg.output_folder,
-        num_processes=num_processes, process_index=process_index,
-    )
+    # One trace context for the whole run: chunk/window ids are pushed
+    # below it, and the recorder guard dumps on the way out of a failure.
+    with tracing.push(run_id=tracing.new_run_id()), recorder:
+        stats = run_chunks(
+            chunks, run_one, cfg.output_folder,
+            num_processes=num_processes, process_index=process_index,
+        )
     stats["chunks_with_pixels"] = len(summaries)
     stats["pixels"] = int(sum(s["n_pixels"] for s in summaries))
     stats["dates_assimilated"] = int(
@@ -505,6 +524,7 @@ def run_config(
     )
     reg = get_registry()
     reg.emit("run_done", **stats)
-    # Snapshot the run's metrics (no-op when no telemetry_dir configured).
+    # Snapshot the run's metrics + trace timeline (no-op when no
+    # telemetry_dir configured).
     reg.dump()
     return stats

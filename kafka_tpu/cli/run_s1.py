@@ -23,7 +23,7 @@ import logging
 
 from ..engine.config import RunConfig
 from ..engine.priors import WCM_PARAMETER_LIST
-from . import make_console
+from . import add_telemetry_arg, make_console
 from .drivers import run_config
 
 
@@ -80,6 +80,7 @@ def main(argv=None):
     ap.add_argument("--noise-floor", type=float, default=None,
                     help="noise-equivalent sigma0 (linear power) added "
                          "in quadrature to the speckle term")
+    add_telemetry_arg(ap)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     logging.basicConfig(
@@ -97,6 +98,8 @@ def main(argv=None):
         cfg.extra["s1_enl"] = args.enl
     if args.noise_floor is not None:
         cfg.extra["s1_noise_floor"] = args.noise_floor
+    if args.telemetry_dir:
+        cfg.telemetry_dir = args.telemetry_dir
 
     stats = run_config(cfg)
     print(json.dumps(stats))

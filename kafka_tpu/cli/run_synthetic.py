@@ -112,10 +112,15 @@ def main(argv=None):
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING
     )
-    from ..telemetry import configure, get_registry
+    from ..telemetry import (
+        configure, flight_recorder, get_registry,
+        install_compile_listeners, tracing,
+    )
 
+    install_compile_listeners()
     if args.telemetry_dir:
         configure(args.telemetry_dir)
+    recorder = flight_recorder.install(args.telemetry_dir)
     if args.mask:
         mask_arr, info = read_geotiff(args.mask)
         mask = mask_arr.astype(bool)
@@ -163,7 +168,10 @@ def main(argv=None):
     ck = Checkpointer(os.path.join(args.outdir, "ckpt")) \
         if args.checkpoint else None
     t0 = time.time()
-    kf.run(time_grid, x0, None, p_inv0, checkpointer=ck)
+    # One trace context for the run; the recorder guard turns a mid-run
+    # death into a crash_<ts>.json next to the other telemetry artifacts.
+    with tracing.push(run_id=tracing.new_run_id()), recorder:
+        kf.run(time_grid, x0, None, p_inv0, checkpointer=ck)
     output.close()
     wall = time.time() - t0
 

@@ -31,7 +31,13 @@ from ..core.types import BandBatch
 from .prefetch import ObservationPrefetcher
 from .protocols import DateObservation, ObservationSource, OutputWriter, Prior
 from .state import PixelGather, make_pixel_gather
-from ..telemetry import fetch_scalars, get_registry, span
+from ..telemetry import (
+    fetch_scalars,
+    get_registry,
+    record_memory_watermark,
+    span,
+    tracing,
+)
 from ..utils.profiling import trace
 
 LOG = logging.getLogger(__name__)
@@ -547,7 +553,10 @@ class KalmanFilter:
                     workers=self.prefetch_workers,
                 )
         try:
-            with trace(profile_dir):
+            # push() keeps the driver's run context when one is active and
+            # otherwise opens a fresh run id, so even a bare engine run
+            # gets one coherent timeline.
+            with trace(profile_dir), tracing.push():
                 return self._run_loop(
                     windows, x_forecast, p_forecast, p_forecast_inverse,
                     checkpointer, advance_first,
@@ -839,67 +848,75 @@ class KalmanFilter:
         self._windows_since_ckpt = 0
         idx = 0
         while idx < len(windows):
-            timestep, locate_times, is_first = windows[idx]
-            # Try to collect a run of fusable windows: each advances, holds
-            # exactly one acquisition, and stacks with the block head.
-            if (
-                self._fusion_possible()
-                and ((not is_first) or advance_first)
-                and len(locate_times) == 1
-            ):
-                block, block_dates = [], []
-                j = idx
-                while j < len(windows) and len(block) < self.scan_window:
-                    ts_j, lt_j, _ = windows[j]
-                    if len(lt_j) != 1:
-                        break
-                    obs_j = self._fetch(lt_j[0])
-                    if (block and not self._stackable(block[0][1], obs_j)) \
-                            or not self._block_fits(obs_j, len(block) + 1):
-                        self._pending_obs[lt_j[0]] = obs_j
-                        break
-                    block.append((ts_j, obs_j))
-                    block_dates.append(lt_j[0])
-                    j += 1
-                # Bucket the block length to a power of two: the scan
-                # program recompiles per distinct K, so free-running block
-                # sizes (broken by sensor changes, grid gaps...) would each
-                # pay a fresh multi-second XLA compile.  Trimmed windows
-                # return their fetched observations via _pending_obs.
-                k_bucket = 1
-                while k_bucket * 2 <= len(block):
-                    k_bucket *= 2
-                for (ts_j, obs_j), date_j in zip(
-                    block[k_bucket:], block_dates[k_bucket:]
+            # window_id correlates everything recorded while processing
+            # this grid window (a fused block carries its HEAD window's id;
+            # the block length is in the records' "fused" field).  The
+            # per-window device-memory watermark rides the same host path —
+            # no device transfer (telemetry.device invariant).
+            with tracing.push(window_id=idx):
+                timestep, locate_times, is_first = windows[idx]
+                # Try to collect a run of fusable windows: each advances, holds
+                # exactly one acquisition, and stacks with the block head.
+                if (
+                    self._fusion_possible()
+                    and ((not is_first) or advance_first)
+                    and len(locate_times) == 1
                 ):
-                    self._pending_obs[date_j] = obs_j
-                block = block[:k_bucket]
-                if len(block) >= 2:
-                    LOG.info(
-                        "Advancing + assimilating %d fused windows "
-                        "%s..%s", len(block), block[0][0], block[-1][0],
-                    )
-                    with span("fused_scan"):
-                        x_analysis, p_analysis, p_analysis_inverse = (
-                            self._run_fused_block(
-                                block, x_analysis, p_analysis,
-                                p_analysis_inverse, checkpointer,
-                                is_last=(idx + len(block) == len(windows)),
-                            )
+                    block, block_dates = [], []
+                    j = idx
+                    while j < len(windows) and len(block) < self.scan_window:
+                        ts_j, lt_j, _ = windows[j]
+                        if len(lt_j) != 1:
+                            break
+                        obs_j = self._fetch(lt_j[0])
+                        if (block and not self._stackable(block[0][1], obs_j)) \
+                                or not self._block_fits(obs_j, len(block) + 1):
+                            self._pending_obs[lt_j[0]] = obs_j
+                            break
+                        block.append((ts_j, obs_j))
+                        block_dates.append(lt_j[0])
+                        j += 1
+                    # Bucket the block length to a power of two: the scan
+                    # program recompiles per distinct K, so free-running block
+                    # sizes (broken by sensor changes, grid gaps...) would each
+                    # pay a fresh multi-second XLA compile.  Trimmed windows
+                    # return their fetched observations via _pending_obs.
+                    k_bucket = 1
+                    while k_bucket * 2 <= len(block):
+                        k_bucket *= 2
+                    for (ts_j, obs_j), date_j in zip(
+                        block[k_bucket:], block_dates[k_bucket:]
+                    ):
+                        self._pending_obs[date_j] = obs_j
+                    block = block[:k_bucket]
+                    if len(block) >= 2:
+                        LOG.info(
+                            "Advancing + assimilating %d fused windows "
+                            "%s..%s", len(block), block[0][0], block[-1][0],
                         )
-                    idx += len(block)
-                    continue
-                if len(block) == 1:
-                    # Hand the fetched observation to the unfused path.
-                    self._pending_obs[locate_times[0]] = block[0][1]
-            x_analysis, p_analysis, p_analysis_inverse = (
-                self._run_one_window(
-                    windows[idx], x_analysis, p_analysis,
-                    p_analysis_inverse, checkpointer, advance_first,
-                    is_last=(idx == len(windows) - 1),
+                        with span("fused_scan"):
+                            x_analysis, p_analysis, p_analysis_inverse = (
+                                self._run_fused_block(
+                                    block, x_analysis, p_analysis,
+                                    p_analysis_inverse, checkpointer,
+                                    is_last=(idx + len(block) == len(windows)),
+                                )
+                            )
+                        idx += len(block)
+                        record_memory_watermark()
+                        continue
+                    if len(block) == 1:
+                        # Hand the fetched observation to the unfused path.
+                        self._pending_obs[locate_times[0]] = block[0][1]
+                x_analysis, p_analysis, p_analysis_inverse = (
+                    self._run_one_window(
+                        windows[idx], x_analysis, p_analysis,
+                        p_analysis_inverse, checkpointer, advance_first,
+                        is_last=(idx == len(windows) - 1),
+                    )
                 )
-            )
-            idx += 1
+                idx += 1
+                record_memory_watermark()
         return x_analysis, p_analysis, p_analysis_inverse
 
     def _run_one_window(self, window, x_analysis, p_analysis,

@@ -29,7 +29,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .protocols import DateObservation, ObservationSource
 from .state import PixelGather
-from ..telemetry import get_registry
+from ..telemetry import get_registry, tracing
 
 LOG = logging.getLogger(__name__)
 
@@ -78,6 +78,11 @@ class ObservationPrefetcher:
         # Telemetry handles bound once (registry resolved at construction
         # — the engine builds prefetchers after the driver's configure()).
         reg = get_registry()
+        self._trace = reg.trace
+        # Cross-thread trace propagation: contextvars do NOT flow into new
+        # threads, so the constructing thread's context (run/chunk ids) is
+        # captured here and re-installed on every worker.
+        self._trace_ctx = tracing.current_context()
         self._m_read = reg.histogram(
             "kafka_prefetch_read_seconds",
             "host-side read/decode/warp/gather seconds per date "
@@ -98,14 +103,21 @@ class ObservationPrefetcher:
         )
         self._threads = [
             threading.Thread(
-                target=self._worker, name=f"obs-prefetch-{i}", daemon=True
+                target=self._worker, args=(i,),
+                name=f"obs-prefetch-{i}", daemon=True,
             )
             for i in range(self._workers)
         ]
         for t in self._threads:
             t.start()
 
-    def _worker(self) -> None:
+    def _worker(self, worker_index: int) -> None:
+        tracing.set_context(self._trace_ctx)
+        # One timeline track per worker thread; the single-worker default
+        # keeps the canonical "prefetch" lane name.
+        tracing.set_lane(
+            "prefetch" if worker_index == 0 else f"prefetch-{worker_index}"
+        )
         while True:
             self._slots.acquire()
             if self._stopped.is_set():
@@ -125,11 +137,18 @@ class ObservationPrefetcher:
             except BaseException as exc:  # re-raised at the caller's get()
                 item = ("error", exc)
             if item[0] == "ok":
-                self._m_read.observe(time.perf_counter() - t0)
+                t1 = time.perf_counter()
+                self._m_read.observe(t1 - t0)
                 self._m_reads.inc()
+                self._trace.add_span(
+                    "prefetch_read", t0, t1, cat="io", date=str(date),
+                )
             with self._cond:
                 self._results[idx] = item
                 self._m_depth.set(len(self._results))
+                self._trace.add_counter(
+                    "prefetch_queue_depth", len(self._results)
+                )
                 if item[0] == "error":
                     # Don't claim past a failure: the run is about to
                     # abort at this date's get(); reading further dates
@@ -150,6 +169,9 @@ class ObservationPrefetcher:
             kind, payload = self._results.pop(idx)
             self._next_emit += 1
             self._m_depth.set(len(self._results))
+            self._trace.add_counter(
+                "prefetch_queue_depth", len(self._results)
+            )
         self._m_wait.observe(time.perf_counter() - t0)
         self._slots.release()
         if kind == "error":

@@ -21,7 +21,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from ..engine.state import PixelGather
-from ..telemetry import get_registry
+from ..telemetry import get_registry, tracing
 from .geotiff import GeoInfo, write_geotiff
 
 
@@ -75,6 +75,11 @@ class GeoTIFFOutput:
         self._worker: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         reg = get_registry()
+        self._trace = reg.trace
+        # Captured for the writer thread: contextvars don't cross thread
+        # creation, so the constructing (engine/chunk) context is
+        # re-installed in _drain to keep the timeline correlated.
+        self._trace_ctx = tracing.current_context()
         self._m_backlog = reg.gauge(
             "kafka_io_writer_backlog",
             "queued dump requests the async writer thread has not "
@@ -131,8 +136,13 @@ class GeoTIFFOutput:
                               self.geo, predictor=self.predictor,
                               level=self.level)
         finally:
+            t1 = time.perf_counter()
             self._m_writes.inc()
-            self._m_write_s.observe(time.perf_counter() - t0)
+            self._m_write_s.observe(t1 - t0)
+            self._trace.add_span(
+                "write", t0, t1, cat="io",
+                timestep=timestep.strftime("%Y-%m-%d"),
+            )
 
     def _to_wire(self, x, p_inv_diag):
         """Device-side downcast (and sigma computation) so the link moves
@@ -171,7 +181,7 @@ class GeoTIFFOutput:
                 (timestep, self._snapshot(x), self._snapshot(unc),
                  gather, tuple(parameter_list), unc_is_sigma)
             )
-            self._m_backlog.set(self._queue.qsize())
+            self._set_backlog(self._queue.qsize())
         else:
             self._write_all(timestep, x, unc, gather, parameter_list,
                             unc_is_sigma)
@@ -189,7 +199,7 @@ class GeoTIFFOutput:
         )
         if self._queue is not None:
             self._queue.put(("block",) + item)
-            self._m_backlog.set(self._queue.qsize())
+            self._set_backlog(self._queue.qsize())
         else:
             self._write_block(*item)
 
@@ -209,7 +219,13 @@ class GeoTIFFOutput:
             return arr  # None, or an immutable device array
         return np.asarray(arr).copy()
 
+    def _set_backlog(self, n: int) -> None:
+        self._m_backlog.set(n)
+        self._trace.add_counter("writer_backlog", n)
+
     def _drain(self):
+        tracing.set_context(self._trace_ctx)
+        tracing.set_lane("writer")
         while True:
             item = self._queue.get()
             if item is None:
@@ -222,7 +238,7 @@ class GeoTIFFOutput:
             except Exception as exc:  # surfaced on next dump/flush/close
                 self._error = exc
             finally:
-                self._m_backlog.set(self._queue.qsize())
+                self._set_backlog(self._queue.qsize())
                 self._queue.task_done()
 
     def _raise_pending(self):

@@ -36,7 +36,7 @@ from typing import Callable, Iterable, List, Optional, Sequence
 import jax
 
 from ..io.tiling import Chunk
-from ..telemetry import get_registry
+from ..telemetry import get_registry, tracing
 
 #: a completed chunk is flagged a straggler when its wall time exceeds
 #: this multiple of the median of the chunks completed before it (with at
@@ -127,8 +127,18 @@ def run_chunks(
     t0 = time.time()
     for a in todo:
         t_chunk = time.perf_counter()
-        run_one(a.chunk, a.prefix)
-        wall = time.perf_counter() - t_chunk
+        # chunk_id scopes every span/event recorded inside the chunk run
+        # (engine phases, writes, reads) to this chunk's forensics.
+        with tracing.push(chunk_id=a.prefix):
+            run_one(a.chunk, a.prefix)
+        t_end = time.perf_counter()
+        wall = t_end - t_chunk
+        # The chunk-level block lands on its own "scheduler" track, so
+        # the timeline shows chunk boundaries above the engine phases.
+        reg.trace.add_span(
+            "chunk", t_chunk, t_end, lane="scheduler", cat="chunk",
+            prefix=a.prefix, chunk=a.chunk.chunk_no,
+        )
         mark_done(outdir, a.prefix, {"chunk": a.chunk.chunk_no,
                                      "wall_s": round(wall, 3)})
         stats["run"] += 1

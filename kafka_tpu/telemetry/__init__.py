@@ -1,24 +1,36 @@
 """First-class observability for the assimilation stack (SURVEY.md §5:
 the reference has none beyond timestamped DEBUG logging).
 
-Three layers, shared by the engine, the prefetch pipeline, the multi-host
+Layers, shared by the engine, the prefetch pipeline, the multi-host
 scheduler, the output writers, the CLI drivers and ``bench.py``:
 
 - :mod:`registry` — the thread-safe host-side metrics store (counters /
   gauges / histograms with labels), JSONL event emission and
   Prometheus-style text exposition;
-- :mod:`spans` — timed engine phases recorded in BOTH the registry and
-  ``jax.profiler`` traces;
+- :mod:`spans` — timed engine phases recorded in the registry,
+  ``jax.profiler`` traces AND the trace timeline;
+- :mod:`tracing` — the distributed trace timeline: run/chunk/window
+  context propagated across threads, completed spans and counter samples
+  exported as Perfetto-openable Chrome trace-event JSON (``trace.json``);
+- :mod:`flight_recorder` — the crash flight recorder: last events + final
+  metrics + thread stacks dumped to ``crash_<ts>.json`` on unhandled
+  exception, SIGTERM/SIGINT or an unhealthy probe verdict;
+- :mod:`compilemon` — compilation-cache hit/miss counters and
+  per-program compile wall time from ``jax.monitoring``;
 - :mod:`device` — the single funnel for packed diagnostic device->host
-  reads (zero-extra-transfer guarantee, counted);
+  reads (zero-extra-transfer guarantee, counted) and the per-window
+  device-memory watermark gauges;
 - :mod:`health` — the host/device health probes (grown out of bench.py),
   readings sourced from the registry.
 
-See BASELINE.md "Observability" for metric names, label conventions and
-the event schema.
+See BASELINE.md "Observability" for metric names, label conventions, the
+event schema, and "Tracing & crash forensics" for the trace/crash
+artifacts.
 """
 
-from .device import fetch_scalars
+from . import flight_recorder, tracing
+from .compilemon import install_compile_listeners
+from .device import fetch_scalars, record_memory_watermark
 from .registry import (
     MetricsRegistry,
     configure,
@@ -32,8 +44,12 @@ __all__ = [
     "MetricsRegistry",
     "configure",
     "fetch_scalars",
+    "flight_recorder",
     "get_registry",
+    "install_compile_listeners",
+    "record_memory_watermark",
     "set_registry",
     "span",
+    "tracing",
     "use",
 ]

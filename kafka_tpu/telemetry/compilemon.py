@@ -1,0 +1,99 @@
+"""Compilation observability: cache hits/misses and per-program compile
+wall time, sourced from ``jax.monitoring``'s host-side event stream.
+
+A cold per-date assimilation program costs ~10 s of XLA compile on TPU
+(``utils.compilation_cache``), and a run that silently recompiles — a new
+scan-block K, an operator rebuilt per chunk, a cache directory miss —
+loses its roofline without any metric saying why.  JAX already announces
+every compile on the host (``monitoring.record_event`` /
+``record_event_duration_secs``); this module forwards the relevant ones
+into the telemetry registry:
+
+- ``kafka_compile_cache_hits_total`` / ``kafka_compile_cache_misses_total``
+  — persistent compilation-cache outcome per program;
+- ``kafka_compile_program_seconds`` — wall seconds per backend compile,
+  plus a ``compile`` JSONL event and a ``cat: "compile"`` span in the
+  trace timeline, so compile stalls show up as visible blocks between the
+  phase spans in ``trace.json``.
+
+Listeners resolve :func:`~.registry.get_registry` at event time, so
+``configure()``/``use()`` swap the sink as usual.  Installation is
+idempotent and degrades to a no-op on a JAX without ``jax.monitoring``.
+All of this rides existing host-side code paths: zero device transfers.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .registry import get_registry
+
+#: jax.monitoring counter events -> registry counters.
+_EVENT_COUNTERS = {
+    "/jax/compilation_cache/cache_hits": (
+        "kafka_compile_cache_hits_total",
+        "persistent compilation-cache hits (program loaded from disk)",
+    ),
+    "/jax/compilation_cache/cache_misses": (
+        "kafka_compile_cache_misses_total",
+        "persistent compilation-cache misses (full XLA compile paid)",
+    ),
+}
+
+#: jax.monitoring duration event for one backend compile.
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+#: compile-wall buckets: spans ~10 ms (tiny CPU programs) .. minutes
+#: (large TPU scan programs).
+_COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                    30.0, 60.0, 180.0)
+
+_installed = False
+
+
+def _on_event(event: str, **kwargs) -> None:
+    hit = _EVENT_COUNTERS.get(event)
+    if hit is not None:
+        name, help = hit
+        get_registry().counter(name, help).inc()
+
+
+def _on_duration(event: str, duration: float, **kwargs) -> None:
+    if event != BACKEND_COMPILE_EVENT:
+        return
+    reg = get_registry()
+    reg.histogram(
+        "kafka_compile_program_seconds",
+        "wall seconds per XLA backend compile",
+        buckets=_COMPILE_BUCKETS,
+    ).observe(duration)
+    fields = {
+        k: v for k, v in kwargs.items() if isinstance(v, (str, int, float))
+    }
+    reg.emit("compile", seconds=round(duration, 3), **fields)
+    # The duration arrives at compile END on the compiling thread: a
+    # synthesized [now - duration, now] span puts the stall on that
+    # thread's track in the timeline.
+    t1 = time.perf_counter()
+    reg.trace.add_span(
+        "xla_compile", t1 - duration, t1, cat="compile", **fields
+    )
+
+
+def install_compile_listeners() -> bool:
+    """Register the listeners once per process; returns False (and stays
+    a no-op) when ``jax.monitoring`` is unavailable."""
+    global _installed
+    if _installed:
+        return True
+    try:
+        from jax import monitoring
+    except ImportError:
+        return False
+    try:
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except AttributeError:
+        return False
+    _installed = True
+    return True

@@ -16,9 +16,11 @@ directory is configured).
 
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
 
-from .registry import get_registry
+from .registry import MetricsRegistry, get_registry
 
 
 def fetch_scalars(packed) -> np.ndarray:
@@ -33,3 +35,45 @@ def fetch_scalars(packed) -> np.ndarray:
         "packed diagnostic device->host reads (one per solve dispatch)",
     ).inc()
     return np.asarray(packed)
+
+
+def record_memory_watermark(
+    registry: Optional[MetricsRegistry] = None,
+) -> None:
+    """Per-device HBM gauges from ``Device.memory_stats()`` — a HOST-side
+    PJRT query, so this rides the engine's per-window host code with zero
+    device->host transfers (the zero-extra-transfer invariant above is
+    untouched).  Degrades to a no-op where the backend reports nothing
+    (CPU returns None).  Each reading also lands as a trace counter
+    track, so HBM pressure lines up with the phase spans in
+    ``trace.json``.
+    """
+    import jax
+
+    reg = registry if registry is not None else get_registry()
+    try:
+        devices = jax.local_devices()
+    except RuntimeError:  # backend not initialisable (stripped build)
+        return
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — per-backend API, optional
+            stats = None
+        if not stats:
+            continue
+        in_use = stats.get("bytes_in_use")
+        peak = stats.get("peak_bytes_in_use")
+        if in_use is not None:
+            reg.gauge(
+                "kafka_device_memory_bytes_in_use",
+                "device memory currently allocated (bytes, per device)",
+            ).set(float(in_use), device=d.id)
+            reg.trace.add_counter(f"device{d.id}_bytes_in_use", in_use)
+        if peak is not None:
+            reg.gauge(
+                "kafka_device_memory_peak_bytes",
+                "high-water mark of device memory allocation (bytes, "
+                "per device)",
+            ).set(float(peak), device=d.id)
+            reg.trace.add_counter(f"device{d.id}_peak_bytes", peak)

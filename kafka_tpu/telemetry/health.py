@@ -155,6 +155,16 @@ def probe_health(retry_wait_s: float = 15.0,
         probe_device_ms=None if device_ms is None else round(device_ms, 3),
         retried=retried, unhealthy=unhealthy, reasons=reasons,
     )
+    if unhealthy:
+        # A persistent off-band verdict is a forensics moment: snapshot
+        # the run state NOW (probe event included), while the weather
+        # that flagged it is live — the run may still die later with no
+        # better evidence.
+        from .flight_recorder import active_recorder
+
+        recorder = active_recorder()
+        if recorder is not None:
+            recorder.dump("unhealthy_probe")
     return {
         "probe_device_ms": None if device_ms is None
         else round(device_ms, 3),

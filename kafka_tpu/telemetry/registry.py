@@ -41,6 +41,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from .tracing import TraceBuffer
+
 #: default histogram buckets (seconds-flavoured: spans ~1 ms .. ~2 min,
 #: which covers phase walls, reads and chunk runs alike).
 DEFAULT_BUCKETS: Tuple[float, ...] = (
@@ -145,6 +147,9 @@ class MetricsRegistry:
         self.events: collections.deque = collections.deque(
             maxlen=max_events
         )
+        #: the run's trace timeline (spans + counter samples); exported
+        #: as Chrome trace-event JSON by dump().  See telemetry.tracing.
+        self.trace = TraceBuffer()
         self._events_fh = None
         if directory:
             os.makedirs(directory, exist_ok=True)
@@ -275,23 +280,37 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
     def dump(self, directory: Optional[str] = None) -> Optional[str]:
-        """Write ``metrics.prom`` + ``metrics.json`` into ``directory``
-        (default: the configured one).  Returns the directory or None when
-        there is nowhere to write."""
+        """Write ``metrics.prom`` + ``metrics.json`` (and ``trace.json``
+        when any spans were recorded) into ``directory`` (default: the
+        configured one).  Returns the directory or None when there is
+        nowhere to write.  The streamed ``events.jsonl`` is flushed first
+        so the three artifacts are mutually consistent on disk."""
         directory = directory or self.directory
         if not directory:
             return None
+        fh = self._events_fh
+        if fh is not None:
+            try:
+                fh.flush()
+            except ValueError:  # lost the race against close()
+                pass
         os.makedirs(directory, exist_ok=True)
         with open(os.path.join(directory, "metrics.prom"), "w") as f:
             f.write(self.prom_text())
         with open(os.path.join(directory, "metrics.json"), "w") as f:
             json.dump(self.snapshot(), f, indent=2, default=str)
+        if len(self.trace):
+            self.trace.export(os.path.join(directory, "trace.json"))
         return directory
 
     def close(self) -> None:
-        if self._events_fh is not None:
-            self._events_fh.close()
-            self._events_fh = None
+        """Close the events stream.  Idempotent and race-safe: the handle
+        is detached under the lock, so concurrent dump()/close() callers
+        flush/close it exactly once."""
+        with self._lock:
+            fh, self._events_fh = self._events_fh, None
+        if fh is not None:
+            fh.close()
 
 
 # ---------------------------------------------------------------------------

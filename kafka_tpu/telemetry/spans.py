@@ -1,12 +1,13 @@
-"""Timed phase spans: one context manager that lands in BOTH sinks.
+"""Timed phase spans: one context manager that lands in THREE sinks.
 
 ``utils.profiling.annotate`` labels host work inside ``jax.profiler``
 traces (TensorBoard/Perfetto timelines); the registry records the same
-span as a wall-time histogram and a JSONL event.  The engine's phases
-(advance / assimilate / dump / fused_scan) use this so a run's phase
-breakdown is readable from the metrics snapshot without ever capturing a
-profiler trace — and when a trace IS captured, the two views carry the
-same names.
+span as a wall-time histogram and a JSONL event; and the registry's
+:class:`~.tracing.TraceBuffer` records it as a timeline span for the
+run's ``trace.json``.  The engine's phases (advance / assimilate / dump /
+fused_scan) use this so a run's phase breakdown is readable from the
+metrics snapshot without ever capturing a profiler trace — and when a
+trace IS captured, all views carry the same names.
 """
 
 from __future__ import annotations
@@ -16,6 +17,7 @@ import time
 from typing import Iterator, Optional
 
 from ..utils.profiling import annotate
+from . import tracing
 from .registry import MetricsRegistry, get_registry
 
 
@@ -25,19 +27,30 @@ def span(phase: str, registry: Optional[MetricsRegistry] = None,
     """Time the enclosed block as engine phase ``phase``.
 
     Shows up as a ``kafka/<phase>`` TraceAnnotation in profiler traces, a
-    ``kafka_engine_phase_seconds{phase=...}`` histogram observation, and a
-    ``phase`` JSONL event (with any extra ``fields`` attached).
+    ``kafka_engine_phase_seconds{phase=...}`` histogram observation, a
+    ``phase`` JSONL event (with any extra ``fields`` attached), and a
+    ``cat: "phase"`` span on the recording thread's track in
+    ``trace.json``.  Nested spans see this one as their ``parent_span``.
+    All sinks record on the exception path too — a phase that dies still
+    leaves its wall time and its place on the timeline.
     """
     reg = registry if registry is not None else get_registry()
+    span_id = tracing.next_span_id()
+    token = tracing.push_parent(span_id)
     with annotate(f"kafka/{phase}"):
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            tracing.pop(token)
+            dt = t1 - t0
             reg.histogram(
                 "kafka_engine_phase_seconds",
                 "wall seconds per engine phase (advance/assimilate/"
                 "dump/fused_scan)",
             ).observe(dt, phase=phase)
             reg.emit("phase", phase=phase, seconds=round(dt, 6), **fields)
+            reg.trace.add_span(
+                phase, t0, t1, cat="phase", span_id=span_id, **fields
+            )

@@ -1,0 +1,132 @@
+"""tools/bench_compare.py (ISSUE 3 satellite): the BENCH-trajectory gate —
+>10% regression on any ``device_*_ms`` row exits non-zero, unhealthy
+artifacts are never judged, telemetry snapshots are diffed for context."""
+
+import importlib.util
+import json
+import os
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare",
+        os.path.join(REPO_ROOT, "tools", "bench_compare.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def artifact(xla_ms=6.4, pallas_ms=3.8, unhealthy=False, telemetry=None):
+    return {
+        "metric": "assimilation_throughput",
+        "device_xla_ms": xla_ms,
+        "device_xla_ms_spread": 0.1,
+        "device_pallas_ms": pallas_ms,
+        "device_pallas_ms_spread": 0.1,
+        "device_ms_matched_median": 1.2,
+        "unhealthy": unhealthy,
+        "telemetry": telemetry or {
+            "kafka_engine_device_reads_total": 8,
+            "kafka_compile_cache_hits_total": 3,
+        },
+    }
+
+
+def write(tmp_path, name, art):
+    p = str(tmp_path / name)
+    with open(p, "w") as f:
+        json.dump(art, f)
+    return p
+
+
+class TestCompareRows:
+    def test_no_regression_within_threshold(self):
+        bc = _load()
+        regressions, _ = bc.compare_rows(
+            artifact(), artifact(xla_ms=6.4 * 1.05)
+        )
+        assert regressions == []
+
+    def test_regression_beyond_threshold_flagged(self):
+        bc = _load()
+        regressions, _ = bc.compare_rows(
+            artifact(), artifact(xla_ms=6.4 * 1.2)
+        )
+        assert len(regressions) == 1
+        assert "device_xla_ms" in regressions[0]
+
+    def test_spread_rows_never_gated(self):
+        bc = _load()
+        new = artifact()
+        new["device_xla_ms_spread"] = 99.0  # noisy spread, same median
+        regressions, _ = bc.compare_rows(artifact(), new)
+        assert regressions == []
+
+    def test_null_pallas_rows_skipped(self):
+        """Off-TPU artifacts carry null Pallas rows; they must not gate
+        (or crash) a comparison against a TPU artifact."""
+        bc = _load()
+        off_tpu = artifact(pallas_ms=None)
+        regressions, lines = bc.compare_rows(off_tpu, artifact())
+        assert regressions == []
+        assert any("device_pallas_ms" in ln and "skipped" in ln
+                   for ln in lines)
+
+    def test_improvement_not_flagged(self):
+        bc = _load()
+        regressions, lines = bc.compare_rows(
+            artifact(xla_ms=6.4), artifact(xla_ms=3.9)
+        )
+        assert regressions == []
+        assert any("improved" in ln for ln in lines)
+
+
+class TestMain:
+    def test_exit_zero_on_parity(self, tmp_path):
+        bc = _load()
+        old = write(tmp_path, "old.json", artifact())
+        new = write(tmp_path, "new.json", artifact())
+        assert bc.main([old, new]) == 0
+
+    def test_exit_nonzero_on_regression(self, tmp_path):
+        bc = _load()
+        old = write(tmp_path, "old.json", artifact())
+        new = write(tmp_path, "new.json", artifact(xla_ms=7.5))
+        assert bc.main([old, new]) == 1
+
+    def test_unhealthy_artifact_never_judged(self, tmp_path, capsys):
+        """A regression measured against (or by) an off-band window is
+        weather, not code — the verdict downgrades to unjudgeable."""
+        bc = _load()
+        old = write(tmp_path, "old.json", artifact())
+        new = write(
+            tmp_path, "new.json", artifact(xla_ms=9.0, unhealthy=True)
+        )
+        assert bc.main([old, new]) == 0
+        assert "UNJUDGEABLE" in capsys.readouterr().err
+
+    def test_custom_threshold(self, tmp_path):
+        bc = _load()
+        old = write(tmp_path, "old.json", artifact())
+        new = write(tmp_path, "new.json", artifact(xla_ms=6.4 * 1.07))
+        assert bc.main([old, new]) == 0
+        assert bc.main([old, new, "--threshold", "0.05"]) == 1
+
+    def test_missing_file_is_usage_error(self, tmp_path):
+        bc = _load()
+        old = write(tmp_path, "old.json", artifact())
+        assert bc.main([old, str(tmp_path / "nope.json")]) == 2
+
+    def test_telemetry_deltas_reported(self, tmp_path, capsys):
+        bc = _load()
+        old = write(tmp_path, "old.json", artifact())
+        new = write(tmp_path, "new.json", artifact(telemetry={
+            "kafka_engine_device_reads_total": 16,
+            "kafka_compile_cache_hits_total": 3,
+        }))
+        assert bc.main([old, new]) == 0
+        out = capsys.readouterr().out
+        assert "kafka_engine_device_reads_total: 8 -> 16" in out

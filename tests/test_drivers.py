@@ -494,6 +494,7 @@ class TestOomRecovery:
             state_mask=str(tmp_path / "mask.tif"),
             output_folder=str(tmp_path / "out"),
             solver_options={"relaxation": 0.7},
+            telemetry_dir=str(tmp_path / "tel"),
         )
         rc, summary = drivers._run_chunk_subprocess(
             cfg, Chunk(0, 0, 64, 48, 1), "0001"
@@ -502,6 +503,16 @@ class TestOomRecovery:
         assert summary["n_pixels"] > 0
         tifs = glob.glob(str(tmp_path / "out" / "*_0001*.tif"))
         assert tifs, "worker wrote no outputs"
+        # ISSUE 3 satellite: the worker exports its run telemetry into a
+        # per-chunk subdirectory (events + metrics + trace timeline).
+        chunk_tel = tmp_path / "tel" / "chunk_0001"
+        for artifact in ("events.jsonl", "metrics.json", "metrics.prom",
+                         "trace.json"):
+            assert (chunk_tel / artifact).exists(), artifact
+        import json as _json
+
+        snap = _json.load(open(chunk_tel / "metrics.json"))
+        assert "kafka_engine_device_reads_total" in snap
 
 
 class TestMosaic:

@@ -1,0 +1,243 @@
+"""The crash flight recorder (ISSUE 3 tentpole): dump contents, the
+mid-run-exception acceptance path, signal handling, and the unhealthy
+health-probe trigger."""
+
+import glob
+import json
+import os
+import signal
+import sys
+
+import numpy as np
+import pytest
+
+from kafka_tpu import telemetry
+from kafka_tpu.telemetry import MetricsRegistry, flight_recorder, tracing
+from kafka_tpu.telemetry.flight_recorder import FlightRecorder
+
+
+def crash_files(directory):
+    return sorted(glob.glob(os.path.join(str(directory), "crash_*.json")))
+
+
+class TestDump:
+    def test_dump_carries_events_metrics_context_threads(self, tmp_path):
+        with telemetry.use(MetricsRegistry()) as reg:
+            reg.counter("kafka_test_total").inc(3)
+            reg.emit("solve", date="2021-01-01", n_iterations=4)
+            rec = FlightRecorder(str(tmp_path))
+            with tracing.push(run_id="rr", chunk_id="0001"):
+                path = rec.dump("sigterm")
+        dump = json.load(open(path))
+        assert dump["reason"] == "sigterm"
+        assert dump["context"]["run_id"] == "rr"
+        assert dump["context"]["chunk_id"] == "0001"
+        assert dump["metrics"]["kafka_test_total"] == 3
+        assert any(e["event"] == "solve" for e in dump["events"])
+        names = {t["name"] for t in dump["threads"]}
+        assert "MainThread" in names
+        # The crash path also flushes the run's normal exports.
+        assert os.path.exists(tmp_path / "metrics.json")
+
+    def test_no_destination_no_dump(self):
+        with telemetry.use(MetricsRegistry()):
+            rec = FlightRecorder(None)
+            assert rec.dump("exception", exc=RuntimeError("x")) is None
+
+    def test_same_exception_dumped_once(self, tmp_path):
+        with telemetry.use(MetricsRegistry()):
+            rec = FlightRecorder(str(tmp_path))
+            exc = RuntimeError("boom")
+            assert rec.dump("exception", exc=exc) is not None
+            assert rec.dump("exception", exc=exc) is None
+        assert len(crash_files(tmp_path)) == 1
+
+
+class TestMidRunException:
+    def test_engine_crash_mid_run_dumps_flight_record(self, tmp_path):
+        """ISSUE 3 acceptance: an exception injected mid-run (a reader
+        that dies on the third date, raised through the prefetch thread
+        into the engine loop) leaves crash_*.json with the last events
+        and the final metric values."""
+        import datetime
+
+        import jax.numpy as jnp
+
+        from kafka_tpu.core.propagators import PixelPrior
+        from kafka_tpu.engine import FixedGaussianPrior, KalmanFilter
+        from kafka_tpu.obsops.identity import IdentityOperator
+        from kafka_tpu.testing import MemoryOutput, SyntheticObservations
+
+        class Boom(RuntimeError):
+            pass
+
+        def day(i):
+            return datetime.datetime(2021, 3, 1) + \
+                datetime.timedelta(days=i)
+
+        mask = np.ones((6, 6), bool)
+        op = IdentityOperator(n_params=2, obs_indices=(0, 1))
+        truth = np.full(mask.shape + (2,), 0.5, np.float32)
+
+        class DyingObservations(SyntheticObservations):
+            def get_observations(self, date, gather):
+                if date >= day(5):
+                    raise Boom(f"reader died at {date}")
+                return super().get_observations(date, gather)
+
+        obs = DyingObservations(
+            dates=[day(1), day(3), day(5), day(7)], operator=op,
+            truth_fn=lambda date: truth, sigma=0.02,
+        )
+        mean = np.full((2,), 0.5, np.float32)
+        cov = np.diag(np.full((2,), 0.25)).astype(np.float32)
+        prior = FixedGaussianPrior(
+            PixelPrior(
+                mean=jnp.asarray(mean), cov=jnp.asarray(cov),
+                inv_cov=jnp.asarray(np.linalg.inv(cov)),
+            ),
+            ("a", "b"),
+        )
+        tel = tmp_path / "tel"
+        with telemetry.use(MetricsRegistry(str(tel))) as reg:
+            rec = FlightRecorder(str(tel))
+            kf = KalmanFilter(
+                obs, MemoryOutput(), mask, ("a", "b"),
+                state_propagation=None, prior=prior,
+                pad_multiple=16, scan_window=1,
+            )
+            kf.set_trajectory_model()
+            kf.set_trajectory_uncertainty(np.zeros(2, np.float32))
+            x0, p_inv0 = prior.process_prior(None, kf.gather)
+            with pytest.raises(Boom):
+                with tracing.push(run_id="crashrun"), rec:
+                    kf.run(
+                        [day(0), day(2), day(4), day(6), day(8)],
+                        x0, None, p_inv0,
+                    )
+            reads_at_death = reg.value("kafka_engine_device_reads_total")
+        files = crash_files(tel)
+        assert len(files) == 1
+        dump = json.load(open(files[0]))
+        assert dump["reason"] == "exception"
+        assert dump["exception"]["type"] == "Boom"
+        assert "reader died" in dump["exception"]["message"]
+        assert dump["context"]["run_id"] == "crashrun"
+        # The last events before death: the two successfully assimilated
+        # dates' solves and their phases are in the ring.
+        kinds = [e["event"] for e in dump["events"]]
+        assert kinds.count("solve") == 2
+        assert "phase" in kinds
+        # Final metric values at the moment of death.
+        assert dump["metrics"]["kafka_engine_device_reads_total"] == \
+            reads_at_death == 2
+        assert "kafka_prefetch_reads_total" in dump["metrics"]
+        # The trace timeline survived the crash alongside the dump.
+        assert os.path.exists(tel / "trace.json")
+
+    def test_run_synthetic_crash_writes_dump(self, tmp_path, monkeypatch):
+        """Driver-level acceptance: run_synthetic with --telemetry-dir
+        dies mid-run -> crash_*.json lands in the telemetry dir."""
+        from kafka_tpu.cli import run_synthetic
+        from kafka_tpu.io import GeoTIFFOutput
+
+        calls = {"n": 0}
+        orig = GeoTIFFOutput.dump_data
+
+        def dying_dump(self, *a, **kw):
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                raise RuntimeError("disk on fire")
+            return orig(self, *a, **kw)
+
+        monkeypatch.setattr(GeoTIFFOutput, "dump_data", dying_dump)
+        # Force the unfused path so dump_data (not dump_block) runs.
+        monkeypatch.setattr(
+            run_synthetic.KalmanFilter, "_fusion_possible",
+            lambda self: False,
+        )
+        tel = str(tmp_path / "tel")
+        prev = telemetry.get_registry()
+        try:
+            with pytest.raises(RuntimeError, match="disk on fire"):
+                run_synthetic.main([
+                    "--operator", "identity",
+                    "--outdir", str(tmp_path / "out"),
+                    "--telemetry-dir", tel,
+                    "--days", "8", "--step", "2",
+                    "--ny", "16", "--nx", "16",
+                ])
+        finally:
+            telemetry.set_registry(prev)
+            flight_recorder.uninstall()
+        files = crash_files(tel)
+        assert len(files) == 1
+        dump = json.load(open(files[0]))
+        assert dump["exception"]["message"] == "disk on fire"
+        assert any(e["event"] == "solve" for e in dump["events"])
+
+
+class TestHooks:
+    def test_install_uninstall_restores_hooks(self, tmp_path):
+        prev_hook = sys.excepthook
+        prev_term = signal.getsignal(signal.SIGTERM)
+        prev_int = signal.getsignal(signal.SIGINT)
+        rec = flight_recorder.install(str(tmp_path))
+        try:
+            assert sys.excepthook != prev_hook
+            assert signal.getsignal(signal.SIGTERM) == rec._on_signal
+            assert flight_recorder.active_recorder() is rec
+            # Re-install re-points the directory, same recorder.
+            assert flight_recorder.install("/elsewhere") is rec
+            assert rec.directory == "/elsewhere"
+        finally:
+            flight_recorder.uninstall()
+        assert sys.excepthook is prev_hook
+        assert signal.getsignal(signal.SIGTERM) == prev_term
+        assert signal.getsignal(signal.SIGINT) == prev_int
+        assert flight_recorder.active_recorder() is None
+
+    def test_sigterm_dumps_and_chains_previous_handler(self, tmp_path):
+        """SIGTERM: dump first, then hand the signal to the previous
+        owner (here a benign handler so the test survives)."""
+        hits = []
+        prev = signal.signal(
+            signal.SIGTERM, lambda s, f: hits.append(s)
+        )
+        try:
+            with telemetry.use(MetricsRegistry()):
+                rec = FlightRecorder(str(tmp_path)).install()
+                try:
+                    signal.raise_signal(signal.SIGTERM)
+                finally:
+                    rec.uninstall()
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+        files = crash_files(tmp_path)
+        assert len(files) == 1
+        assert json.load(open(files[0]))["reason"] == "sigterm"
+        assert hits == [signal.SIGTERM]  # previous owner still ran
+
+
+class TestUnhealthyProbeTrigger:
+    def test_unhealthy_probe_verdict_dumps(self, tmp_path, monkeypatch):
+        from kafka_tpu.telemetry import health
+
+        # Force both probe rounds off-band without waiting for a retry.
+        monkeypatch.setattr(health, "HEALTHY_HOST_MS", -1.0)
+        with telemetry.use(MetricsRegistry()):
+            rec = FlightRecorder(str(tmp_path))
+            monkeypatch.setattr(
+                flight_recorder, "_active", rec
+            )
+            verdict = health.probe_health(retry_wait_s=0.0)
+        assert verdict["unhealthy"]
+        files = crash_files(tmp_path)
+        assert len(files) == 1
+        dump = json.load(open(files[0]))
+        assert dump["reason"] == "unhealthy_probe"
+        probe_events = [
+            e for e in dump["events"] if e["event"] == "health_probe"
+        ]
+        assert probe_events and probe_events[-1]["unhealthy"]
+        assert "kafka_health_probe_host_ms" in dump["metrics"]

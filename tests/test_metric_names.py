@@ -60,6 +60,70 @@ def test_checker_flags_empty_scan(tmp_path):
     assert errors and "no metric registrations" in errors[0]
 
 
+def test_event_and_phase_names_collected():
+    """The scanners must see the production emit()/span() vocabulary
+    (regex rot would silently lint nothing)."""
+    checker = _load_checker()
+    events = checker.collect_names(REPO_ROOT, checker.EMIT_RE)
+    phases = checker.collect_names(REPO_ROOT, checker.SPAN_RE)
+    assert {"solve", "phase", "run_done", "chunk_done",
+            "health_probe"} <= set(events)
+    assert {"advance", "assimilate", "dump", "fused_scan"} <= set(phases)
+
+
+def test_checker_flags_event_casing_and_near_duplicates(tmp_path):
+    checker = _load_checker()
+    pkg = tmp_path / "kafka_tpu"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        'reg.emit("chunkDone", n=1)\n'          # off-convention casing
+        'reg.emit("chunk_done", n=1)\n'         # + near-duplicate of it
+        'with span("advance"):\n    pass\n'
+    )
+    (pkg / "b.py").write_text(
+        'reg.counter("kafka_engine_ok_total")\n'
+        'with span("Fused_Scan"):\n    pass\n'  # off-convention phase
+    )
+    (tmp_path / "bench.py").write_text("")
+    text = "\n".join(checker.check(str(tmp_path)))
+    assert "'chunkDone'" in text and "not lower_snake_case" in text
+    assert "'Fused_Scan'" in text
+    assert "near-duplicate" in text and "chunk_done" in text
+
+
+def test_checker_flags_event_phase_name_collision(tmp_path):
+    checker = _load_checker()
+    pkg = tmp_path / "kafka_tpu"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        'reg.counter("kafka_engine_ok_total")\n'
+        'reg.emit("dump", n=1)\n'
+        'with span("dump"):\n    pass\n'
+    )
+    (tmp_path / "bench.py").write_text("")
+    text = "\n".join(checker.check(str(tmp_path)))
+    assert "both an event and a span phase" in text
+
+
+def test_exact_duplicates_across_sites_allowed(tmp_path):
+    """run_done is legitimately emitted by each driver and span('dump')
+    by both engine paths — same-literal reuse is NOT an error."""
+    checker = _load_checker()
+    pkg = tmp_path / "kafka_tpu"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        'reg.counter("kafka_engine_ok_total")\n'
+        'reg.emit("run_done", n=1)\n'
+        'with span("dump"):\n    pass\n'
+    )
+    (pkg / "b.py").write_text(
+        'reg.emit("run_done", n=2)\n'
+        'with span("dump"):\n    pass\n'
+    )
+    (tmp_path / "bench.py").write_text("")
+    assert checker.check(str(tmp_path)) == []
+
+
 def test_checker_main_exits_zero_on_repo():
     checker = _load_checker()
     assert checker.main([REPO_ROOT]) == 0

@@ -105,6 +105,41 @@ class TestRegistry:
         assert snap["kafka_test_total"]["series"][0]["value"] == 1
         assert os.path.exists(os.path.join(d, "metrics.prom"))
 
+    def test_dump_races_close_single_flush_close(self, tmp_path):
+        """The events.jsonl handle must be flushed/closed exactly once
+        when dump() races close(): close() detaches the handle under the
+        registry lock, dump() tolerates losing the race (no ValueError
+        from a closed file), and every pre-close event is on disk."""
+        for attempt in range(20):
+            d = str(tmp_path / f"tel{attempt}")
+            reg = MetricsRegistry(d)
+            reg.counter("kafka_test_total").inc()
+            for i in range(50):
+                reg.emit("tick", i=i)
+            errors = []
+            barrier = threading.Barrier(4)
+
+            def racer(fn):
+                barrier.wait()
+                try:
+                    for _ in range(5):
+                        fn()
+                except Exception as exc:  # noqa: BLE001 — test collects
+                    errors.append(exc)
+
+            threads = [
+                threading.Thread(target=racer, args=(fn,))
+                for fn in (reg.dump, reg.dump, reg.close, reg.close)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert errors == []
+            assert reg._events_fh is None  # closed exactly once, stays so
+            lines = open(os.path.join(d, "events.jsonl")).readlines()
+            assert len(lines) == 50  # every pre-close event flushed
+
     def test_use_swaps_default_registry(self):
         before = telemetry.get_registry()
         with telemetry.use(MetricsRegistry()) as reg:
@@ -126,6 +161,9 @@ class TestSpan:
             assert reg.events[-1]["phase"] == "advance"
 
     def test_span_records_on_exception(self):
+        """The exception path records ALL sinks: histogram observation,
+        JSONL event, and the trace-timeline span — a phase that dies
+        still leaves its wall time and its place on the timeline."""
         with telemetry.use(MetricsRegistry()) as reg:
             with pytest.raises(RuntimeError):
                 with telemetry.span("assimilate"):
@@ -134,6 +172,12 @@ class TestSpan:
                 "kafka_engine_phase_seconds", phase="assimilate"
             )
             assert st["count"] == 1
+            assert reg.events[-1]["event"] == "phase"
+            assert reg.events[-1]["phase"] == "assimilate"
+            assert reg.events[-1]["seconds"] >= 0
+            spans = [e for e in reg.trace.to_chrome()["traceEvents"]
+                     if e["ph"] == "X"]
+            assert [s["name"] for s in spans] == ["assimilate"]
 
 
 class TestEngineTelemetry:

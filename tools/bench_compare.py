@@ -1,0 +1,152 @@
+"""Compare two BENCH JSON artifacts and gate on device-time regressions.
+
+The repo archives one BENCH JSON per round (``BENCH_r0*.json``) but
+nothing ever *read* two of them side by side — the bench trajectory was
+write-only.  This tool makes it actionable:
+
+- compares every ``device_*_ms`` timing row shared by the two artifacts
+  and **exits non-zero when any regresses by more than the threshold**
+  (default 10%, new > old * 1.10) — the CI gate for perf PRs;
+- refuses to issue a REGRESSION verdict off artifacts flagged
+  ``unhealthy`` (rounds 3-5 proved those archive environment weather, not
+  code): off-band artifacts downgrade the verdict to UNJUDGEABLE
+  (exit 0 with a loud warning) rather than failing a PR on tunnel noise;
+- diffs the embedded ``"telemetry"`` registry snapshots (PR 2's compact
+  counter/gauge view) and reports the largest relative changes —
+  convergence iterations, device reads, compile-cache hits — so a timing
+  shift arrives with its likely cause attached.
+
+Usage:
+    python tools/bench_compare.py OLD.json NEW.json [--threshold 0.10]
+
+Exit codes: 0 ok (or unjudgeable), 1 regression, 2 usage/load error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: timing rows gated on regression (smaller is better, milliseconds).
+DEVICE_ROW_PATTERN = "device_*_ms"
+
+
+def device_rows(artifact: dict) -> Dict[str, float]:
+    """The artifact's gateable timing rows (nulls — e.g. the Pallas rows
+    off-TPU — are dropped; spreads are diagnostics, not gates)."""
+    return {
+        k: float(v) for k, v in artifact.items()
+        if fnmatch.fnmatch(k, DEVICE_ROW_PATTERN)
+        and not k.endswith("_spread")
+        and isinstance(v, (int, float))
+    }
+
+
+def compare_rows(old: dict, new: dict, threshold: float = 0.10,
+                 ) -> Tuple[List[str], List[str]]:
+    """(regressions, report_lines) over the shared device timing rows."""
+    rows_old, rows_new = device_rows(old), device_rows(new)
+    regressions: List[str] = []
+    lines: List[str] = []
+    for key in sorted(set(rows_old) | set(rows_new)):
+        a, b = rows_old.get(key), rows_new.get(key)
+        if a is None or b is None:
+            lines.append(f"  {key}: only in {'new' if a is None else 'old'} "
+                         "artifact — skipped")
+            continue
+        delta = (b - a) / a if a else 0.0
+        verdict = "ok"
+        if delta > threshold:
+            verdict = "REGRESSION"
+            regressions.append(
+                f"{key}: {a:.3f} -> {b:.3f} ms (+{100 * delta:.1f}% "
+                f"> {100 * threshold:.0f}%)"
+            )
+        elif delta < -threshold:
+            verdict = "improved"
+        lines.append(
+            f"  {key}: {a:.3f} -> {b:.3f} ms ({100 * delta:+.1f}%) "
+            f"{verdict}"
+        )
+    if not rows_old or not rows_new:
+        lines.append("  (no shared device_*_ms rows to compare)")
+    return regressions, lines
+
+
+def telemetry_deltas(old: dict, new: dict, top: int = 8) -> List[str]:
+    """Largest relative changes between the embedded registry snapshots
+    (context for a timing shift; never gated on)."""
+    t_old = old.get("telemetry") or {}
+    t_new = new.get("telemetry") or {}
+    changes: List[Tuple[float, str]] = []
+    for key in sorted(set(t_old) & set(t_new)):
+        a, b = t_old[key], t_new[key]
+        if not isinstance(a, (int, float)) or \
+                not isinstance(b, (int, float)) or a == b:
+            continue
+        rel = abs(b - a) / max(abs(a), 1e-12)
+        changes.append((rel, f"  {key}: {a:g} -> {b:g}"))
+    changes.sort(reverse=True)
+    out = [line for _, line in changes[:top]]
+    missing = [k for k in ("telemetry",) if k not in old or k not in new]
+    if missing:
+        out.append("  (one artifact carries no telemetry snapshot)")
+    return out
+
+
+def load(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"bench_compare: cannot load {path}: {exc}",
+              file=sys.stderr)
+        return None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("old", help="baseline BENCH JSON")
+    ap.add_argument("new", help="candidate BENCH JSON")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative regression gate on device_*_ms rows "
+                         "(default 0.10 = 10%%)")
+    args = ap.parse_args(argv)
+    old, new = load(args.old), load(args.new)
+    if old is None or new is None:
+        return 2
+    regressions, lines = compare_rows(old, new, args.threshold)
+    print(f"bench_compare: {args.old} -> {args.new}")
+    for line in lines:
+        print(line)
+    deltas = telemetry_deltas(old, new)
+    if deltas:
+        print("telemetry deltas (context, not gated):")
+        for line in deltas:
+            print(line)
+    unhealthy = [
+        name for name, art in (("old", old), ("new", new))
+        if art.get("unhealthy")
+    ]
+    if regressions and unhealthy:
+        print(
+            f"bench_compare: UNJUDGEABLE — {' and '.join(unhealthy)} "
+            "artifact(s) flagged unhealthy (environment weather, not "
+            "code); re-measure in a healthy window",
+            file=sys.stderr,
+        )
+        return 0
+    if regressions:
+        for r in regressions:
+            print(f"bench_compare: REGRESSION {r}", file=sys.stderr)
+        return 1
+    print("bench_compare: OK — no device timing regression "
+          f"beyond {100 * args.threshold:.0f}%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
