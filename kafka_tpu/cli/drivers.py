@@ -173,8 +173,11 @@ def run_one_chunk(
         # failed attempt and eat into a retry's device memory.
         try:
             output.close()
-        except Exception:
-            pass
+        except Exception as close_exc:
+            LOG.warning(
+                "output teardown after a failed run also failed "
+                "(original error propagates): %s", close_exc,
+            )
         raise
     output.close()
     return {

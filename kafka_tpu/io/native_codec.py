@@ -25,7 +25,9 @@ def _load_native():
             from ..native import load_library
 
             _native = load_library()
-        except Exception:
+        except (OSError, ImportError):
+            # dlopen of a stale/foreign .so can fail even after a build
+            # reported success — the serial zlib path is always correct.
             _native = False
     return _native
 

@@ -29,7 +29,9 @@ def ensure_built(quiet: bool = True) -> bool:
             check=True,
             capture_output=quiet,
         )
-    except Exception:
+    except (OSError, subprocess.SubprocessError):
+        # No make / no compiler / build failure: expected on minimal
+        # hosts — every caller falls back to the pure-Python codec.
         return False
     return os.path.exists(_SO)
 

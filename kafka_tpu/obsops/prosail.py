@@ -87,6 +87,8 @@ def _tav_host(alpha_deg: float, n: np.ndarray) -> np.ndarray:
     published closed form is an analytic antiderivative of this).  Only
     needed for per-band constants, never traced."""
     theta = np.linspace(0.0, np.deg2rad(alpha_deg), 512)[None, :]  # (1, t)
+    # kafkalint: disable=implicit-f64 — host-only per-band constant, f64 is
+    # the point of the exact integration (never traced)
     n = np.asarray(n, np.float64)[:, None]                         # (b, 1)
     sin_t = np.sin(theta)
     cos_t = np.cos(theta)
@@ -110,7 +112,7 @@ def expint_e1(x):
     x = jnp.maximum(x, 1e-8)
     # series for x <= 1
     a = jnp.array([-0.57721566, 0.99999193, -0.24991055,
-                   0.05519968, -0.00976004, 0.00107857])
+                   0.05519968, -0.00976004, 0.00107857], jnp.float32)
     xs = jnp.minimum(x, 1.0)
     small = (
         a[0] + xs * (a[1] + xs * (a[2] + xs * (a[3] + xs * (a[4] + xs * a[5]))))
@@ -488,8 +490,9 @@ class ProsailOperator(ObservationModel):
     def forward_pixel(self, aux: Optional[ProsailAux], x_pixel):
         if aux is None:
             aux = ProsailAux(
-                sza=jnp.asarray(30.0), vza=jnp.asarray(0.0),
-                raa=jnp.asarray(0.0),
+                sza=jnp.asarray(30.0, jnp.float32),
+                vza=jnp.asarray(0.0, jnp.float32),
+                raa=jnp.asarray(0.0, jnp.float32),
             )
         n, cab, car, cbrown, cw, cm, lai, ala, bsoil, psoil = (
             inverse_transforms(x_pixel)

@@ -1,0 +1,15 @@
+"""Seeded implicit-f64 violations (device-code module by path)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def promote(x):
+    a = np.asarray(x, np.float64)  # expect: implicit-f64
+    b = jnp.zeros(4, dtype="float64")  # expect: implicit-f64
+    c = jnp.asarray(0.5)  # expect: implicit-f64
+    d = jnp.array([1.0, -2.5])  # expect: implicit-f64
+    ok_dtype = jnp.asarray(0.5, jnp.float32)
+    ok_var = jnp.asarray(x)
+    ok_int = jnp.asarray(3)
+    return a, b, c, d, ok_dtype, ok_var, ok_int

@@ -1,0 +1,45 @@
+"""Seeded host-transfer-in-jit and static-arg-flag violations.
+
+Parsed by tests/test_lint.py, never imported.  This path sits under
+``kafka_tpu/core/`` so kafkalint classifies it as a device-code module.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def leaky_update(x, p_inv):
+    y = np.asarray(x)  # expect: host-transfer-in-jit
+    s = float(x[0])  # expect: host-transfer-in-jit
+    t = x.sum().item()  # expect: host-transfer-in-jit
+    d = jax.device_get(p_inv)  # expect: host-transfer-in-jit
+    return jnp.asarray(y) + s + t + d
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def flagged_solve(x, use_pallas: bool, block: int = 128, mode: str = "gn"):  # expect: static-arg-flag, static-arg-flag
+    return x
+
+
+def scan_with_host_io(xs):
+    def body(carry, inp):
+        np.save("/tmp/leak.npy", inp)  # expect: host-transfer-in-jit
+        return carry, inp
+
+    return jax.lax.scan(body, 0.0, xs)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def compliant(x, interpret: bool = False, mode: str = "gn"):
+    # Statics named in static_argnums, float() on a static shape read,
+    # and host numpy only OUTSIDE the jit region: all fine.
+    return x * 2.0
+
+
+def host_side(x):
+    n = float(x.shape[0])
+    return np.asarray(x) + n

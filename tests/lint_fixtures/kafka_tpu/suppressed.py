@@ -1,0 +1,26 @@
+"""Violations under inline suppressions — kafkalint must report NOTHING.
+
+Exercises the trailing form, the comment-block-above form, and the
+precedence of ``kafkalint: disable`` over the bare-except comment check.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def hush(x):
+    a = np.asarray(x)  # kafkalint: disable=host-transfer-in-jit — parity probe
+    # The read below is deliberate: this fixture documents the
+    # comment-block form of the directive.
+    # kafkalint: disable=host-transfer-in-jit
+    b = float(x[0])
+    return jnp.asarray(a) + b
+
+
+def quiet(fn):
+    try:
+        fn()
+    except Exception:  # kafkalint: disable=bare-except
+        pass

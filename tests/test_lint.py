@@ -1,0 +1,262 @@
+"""Tier-1 wiring of tools/kafkalint (ISSUE 4): the JAX/TPU hazard and
+repo-convention lints run over the production tree on every test run, so
+a hidden host transfer, an f64 leak, an untracked thread, a silent
+exception swallow or a telemetry-vocabulary drift breaks the suite —
+not a TPU bench run three PRs later.
+
+Also pins the plugin framework itself: every seeded violation in
+tests/lint_fixtures/ must be reported by exactly its intended rule (the
+``# expect: <rule>`` annotations), inline suppressions must silence,
+the baseline must grandfather and age out, and the --json schema must
+stay stable.
+"""
+
+import collections
+import io
+import json
+import os
+import re
+import sys
+import textwrap
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from tools.kafkalint import cli, core  # noqa: E402
+from tools.kafkalint.core import iter_files, make_rules, run_lint  # noqa: E402
+
+FIXTURES = os.path.join(REPO_ROOT, "tests", "lint_fixtures")
+
+EXPECT_RE = re.compile(r"#\s*expect:\s*([a-z0-9\-, ]+)")
+
+ALL_RULES = {
+    "host-transfer-in-jit", "implicit-f64", "untracked-thread",
+    "bare-except", "static-arg-flag", "metric-name", "event-name",
+    "event-collision",
+}
+
+
+# ---------------------------------------------------------------------------
+# The production tree must lint clean (empty baseline is the goal state).
+# ---------------------------------------------------------------------------
+
+def test_production_tree_is_clean():
+    result = run_lint(REPO_ROOT)
+    assert result.findings == [], "\n".join(
+        f.format() for f in result.findings
+    )
+
+
+def test_cli_exits_zero_on_production_tree(capsys):
+    assert cli.main([REPO_ROOT]) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_scanned_set_covers_bench_and_tools():
+    """bench.py and the tools scripts (bench_compare, roofline) are in
+    the scanned set — an empty walk must never pass silently."""
+    rels = {
+        os.path.relpath(p, REPO_ROOT).replace(os.sep, "/")
+        for p in iter_files(REPO_ROOT)
+    }
+    assert "bench.py" in rels
+    assert "tools/bench_compare.py" in rels
+    assert "tools/roofline.py" in rels
+    assert any(r.startswith("kafka_tpu/core/") for r in rels)
+    assert len(rels) > 60
+
+
+def test_all_rules_registered():
+    names = {r.name for r in make_rules()}
+    assert ALL_RULES <= names
+
+
+# ---------------------------------------------------------------------------
+# Fixture tree: findings must match the # expect annotations EXACTLY.
+# ---------------------------------------------------------------------------
+
+def _expected_findings():
+    expected = collections.Counter()
+    for dirpath, _dirnames, filenames in os.walk(FIXTURES):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, FIXTURES).replace(os.sep, "/")
+            with open(path) as f:
+                for lineno, line in enumerate(f, start=1):
+                    m = EXPECT_RE.search(line)
+                    if not m:
+                        continue
+                    for rule in m.group(1).split(","):
+                        expected[(rel, lineno, rule.strip())] += 1
+    return expected
+
+
+def test_fixture_findings_match_annotations_exactly():
+    result = run_lint(FIXTURES)
+    actual = collections.Counter(
+        (f.path, f.line, f.rule) for f in result.findings
+    )
+    expected = _expected_findings()
+    assert expected, "fixture annotations went missing"
+    missing = expected - actual
+    surplus = actual - expected
+    assert not missing and not surplus, (
+        f"missing findings: {sorted(missing)}\n"
+        f"unexpected findings: {sorted(surplus)}"
+    )
+
+
+def test_every_rule_has_a_seeded_fixture_violation():
+    rules_seeded = {rule for _, _, rule in _expected_findings()}
+    assert rules_seeded == ALL_RULES
+
+
+def test_suppressed_fixture_reports_nothing():
+    result = run_lint(FIXTURES)
+    assert not any("suppressed" in f.path for f in result.findings)
+
+
+# ---------------------------------------------------------------------------
+# Suppression mechanics in isolation.
+# ---------------------------------------------------------------------------
+
+def _write_tree(tmp_path, name, body):
+    tools_dir = tmp_path / "tools"
+    tools_dir.mkdir(exist_ok=True)
+    (tools_dir / name).write_text(textwrap.dedent(body))
+
+
+def test_trailing_suppression_silences_only_its_line(tmp_path):
+    _write_tree(tmp_path, "s.py", """\
+        def f(fn):
+            try:
+                fn()
+            except Exception:  # kafkalint: disable=bare-except
+                pass
+            try:
+                fn()
+            except Exception:
+                pass
+        """)
+    result = run_lint(str(tmp_path))
+    assert [f.line for f in result.findings] == [8]
+    assert result.findings[0].rule == "bare-except"
+
+
+def test_disable_all_and_comment_block_form(tmp_path):
+    _write_tree(tmp_path, "s.py", """\
+        def f(fn):
+            try:
+                fn()
+            # the teardown is best-effort by design
+            # kafkalint: disable=all
+            except Exception:
+                pass
+        """)
+    assert run_lint(str(tmp_path)).findings == []
+
+
+# ---------------------------------------------------------------------------
+# Baseline: grandfather, then age out.
+# ---------------------------------------------------------------------------
+
+_VIOLATION = """\
+    def f(fn):
+        try:
+            fn()
+        except Exception:
+            pass
+    """
+
+
+def _write_baseline(tmp_path, entries):
+    bl_dir = tmp_path / "tools" / "kafkalint"
+    bl_dir.mkdir(parents=True, exist_ok=True)
+    (bl_dir / "baseline.json").write_text(json.dumps(entries))
+
+
+def test_baseline_grandfathers_matching_finding(tmp_path):
+    _write_tree(tmp_path, "legacy.py", _VIOLATION)
+    _write_baseline(tmp_path, [{
+        "rule": "bare-except", "path": "tools/legacy.py",
+        "contains": "swallows the error",
+        "reason": "pre-kafkalint code, tracked for cleanup",
+    }])
+    result = run_lint(str(tmp_path))
+    assert result.findings == []
+    assert result.baseline_entries == 1
+    assert result.baseline_matched == 1
+
+
+def test_stale_baseline_entry_is_a_finding(tmp_path):
+    _write_tree(tmp_path, "clean.py", "X = 1\n")
+    _write_baseline(tmp_path, [{
+        "rule": "bare-except", "path": "tools/gone.py",
+        "contains": "", "reason": "file was deleted",
+    }])
+    result = run_lint(str(tmp_path))
+    assert [f.rule for f in result.findings] == ["stale-baseline"]
+    assert "tools/gone.py" in result.findings[0].message
+
+
+def test_no_baseline_flag_ignores_baseline(tmp_path):
+    _write_tree(tmp_path, "legacy.py", _VIOLATION)
+    _write_baseline(tmp_path, [{
+        "rule": "bare-except", "path": "tools/legacy.py",
+        "contains": "", "reason": "grandfathered",
+    }])
+    result = run_lint(str(tmp_path), use_baseline=False)
+    assert [f.rule for f in result.findings] == ["bare-except"]
+
+
+# ---------------------------------------------------------------------------
+# CLI: --json schema and exit codes.
+# ---------------------------------------------------------------------------
+
+def test_json_output_schema(capsys):
+    rc = cli.main([FIXTURES, "--json"])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["version"] == 1
+    assert payload["root"] == os.path.abspath(FIXTURES)
+    assert payload["files_scanned"] == 6
+    assert set(payload["rules"]) >= ALL_RULES
+    assert isinstance(payload["findings"], list) and payload["findings"]
+    for f in payload["findings"]:
+        assert set(f) == {"rule", "path", "line", "message"}
+        assert isinstance(f["line"], int) and f["line"] > 0
+    bl = payload["baseline"]
+    assert set(bl) == {"path", "entries", "matched"}
+    assert bl["path"] is None  # fixtures carry no baseline file
+
+
+def test_json_output_clean_tree(tmp_path, capsys):
+    _write_tree(tmp_path, "ok.py", "X = 1\n")
+    rc = cli.main([str(tmp_path), "--json"])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["findings"] == []
+
+
+def test_rules_subset_and_unknown_rule(tmp_path, capsys):
+    _write_tree(tmp_path, "legacy.py", _VIOLATION)
+    assert cli.main([str(tmp_path), "--rules", "implicit-f64"]) == 0
+    capsys.readouterr()
+    assert cli.main([str(tmp_path), "--rules", "no-such-rule"]) == 2
+    assert "unknown rule" in capsys.readouterr().err
+
+
+def test_list_rules(capsys):
+    assert cli.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ALL_RULES:
+        assert rule in out
+
+
+def test_parse_error_is_reported(tmp_path):
+    _write_tree(tmp_path, "broken.py", "def f(:\n")
+    result = run_lint(str(tmp_path))
+    assert [f.rule for f in result.findings] == ["parse-error"]

@@ -1,0 +1,6 @@
+"""Repo tooling: benchmark comparison, roofline analysis, static lints.
+
+A package so ``python -m tools.kafkalint`` works from the repo root; the
+individual scripts (``bench_compare.py``, ``roofline.py``, ...) remain
+directly runnable as before.
+"""

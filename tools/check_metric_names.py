@@ -1,30 +1,12 @@
-"""Static telemetry-name lint: metrics, event names, span phases.
+"""Static telemetry-name lint — compatibility shim over tools.kafkalint.
 
-Greps every ``.counter("...")`` / ``.gauge("...")`` / ``.histogram("...")``
-registration in the production tree (``kafka_tpu/`` + ``bench.py``) and
-fails on:
-
-- a name not matching the documented ``kafka_<subsystem>_<name>``
-  convention (BASELINE.md "Observability");
-- the same name registered at more than one source location (each metric
-  has exactly ONE owner — duplicated literals drift apart silently);
-- the same name registered as two different kinds.
-
-It also lints the ``emit("...")`` event names and ``span("...")`` phase
-names (the JSONL event log and the trace timeline share these
-vocabularies with dashboards and the crash dumps):
-
-- names must be lower_snake_case (``^[a-z][a-z0-9_]*$``) — off-convention
-  casing silently forks a grep/dashboard query;
-- two DIFFERENT literals that normalise to the same name (case or
-  underscore variants, e.g. ``chunk_done`` vs ``chunkDone``) are
-  near-duplicates that would split one logical event across two names;
-- one name used as BOTH an event kind and a span phase is flagged — one
-  name, one meaning.  (The same literal at several sites is fine: e.g.
-  ``run_done`` is legitimately emitted by each driver.)
-
-Wired into tier-1 as ``tests/test_metric_names.py``, so a telemetry
-regression breaks the suite instead of the dashboard.
+The implementation moved into the kafkalint framework
+(``tools/kafkalint/rules_telemetry.py``), where the same three checks run
+as the ``metric-name`` / ``event-name`` / ``event-collision`` rules with
+shared suppression syntax and output.  This shim keeps the original CLI,
+exit codes, and module API (``check``, ``collect_registrations``,
+``collect_names``, the regexes) exactly as before, so existing callers —
+``tests/test_metric_names.py`` in particular — work unchanged.
 
 Usage:
     python tools/check_metric_names.py [repo_root]
@@ -33,157 +15,28 @@ Usage:
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import Dict, List, Tuple
 
-#: registration call with a literal first argument.
-REGISTRATION_RE = re.compile(
-    r"\.\s*(counter|gauge|histogram)\(\s*\n?\s*\"([^\"]+)\"", re.MULTILINE
+#: this file is loaded by path (importlib spec / direct execution), so
+#: make the repo root importable before reaching for the package.
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.kafkalint.rules_telemetry import (  # noqa: E402,F401
+    EMIT_RE,
+    EVENT_NAME_RE,
+    NAME_RE,
+    REGISTRATION_RE,
+    SCAN,
+    SPAN_RE,
+    check,
+    check_event_and_phase_names,
+    collect_names,
+    collect_registrations,
+    iter_sources,
+    main,
 )
-NAME_RE = re.compile(r"^kafka_[a-z0-9]+_[a-z0-9_]+$")
-
-#: emit("...") event and span("...") phase call sites with a literal
-#: first argument (the lookbehind keeps trace_span()/add_span() out of
-#: the span scan — those carry arbitrary span names, not engine phases).
-EMIT_RE = re.compile(r"\.\s*emit\(\s*\n?\s*\"([^\"]+)\"", re.MULTILINE)
-SPAN_RE = re.compile(r"(?<!\w)span\(\s*\n?\s*\"([^\"]+)\"", re.MULTILINE)
-EVENT_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
-
-#: production sources scanned for registrations, relative to the root.
-SCAN = ("kafka_tpu", "bench.py")
-
-
-def iter_sources(root: str):
-    for entry in SCAN:
-        path = os.path.join(root, entry)
-        if os.path.isfile(path):
-            yield path
-        else:
-            for dirpath, _dirnames, filenames in os.walk(path):
-                for fn in sorted(filenames):
-                    if fn.endswith(".py"):
-                        yield os.path.join(dirpath, fn)
-
-
-def collect_registrations(
-    root: str,
-) -> Dict[str, List[Tuple[str, int, str]]]:
-    """name -> [(relative_path, line, kind), ...] over the scanned tree."""
-    out: Dict[str, List[Tuple[str, int, str]]] = {}
-    for path in iter_sources(root):
-        with open(path) as f:
-            text = f.read()
-        for m in REGISTRATION_RE.finditer(text):
-            kind, name = m.group(1), m.group(2)
-            line = text.count("\n", 0, m.start()) + 1
-            rel = os.path.relpath(path, root)
-            out.setdefault(name, []).append((rel, line, kind))
-    return out
-
-
-def collect_names(root: str, regex: re.Pattern,
-                  ) -> Dict[str, List[Tuple[str, int]]]:
-    """literal first-arg -> [(relative_path, line), ...] for ``regex``."""
-    out: Dict[str, List[Tuple[str, int]]] = {}
-    for path in iter_sources(root):
-        with open(path) as f:
-            text = f.read()
-        for m in regex.finditer(text):
-            line = text.count("\n", 0, m.start()) + 1
-            rel = os.path.relpath(path, root)
-            out.setdefault(m.group(1), []).append((rel, line))
-    return out
-
-
-def check_event_and_phase_names(root: str) -> List[str]:
-    """emit()/span() vocabulary violations (empty list = clean)."""
-    errors: List[str] = []
-    events = collect_names(root, EMIT_RE)
-    phases = collect_names(root, SPAN_RE)
-    #: normalised form -> {(namespace, literal): sites}
-    by_norm: Dict[str, Dict[Tuple[str, str], List[Tuple[str, int]]]] = {}
-    for namespace, names in (("event", events), ("phase", phases)):
-        for name, sites in names.items():
-            where = ", ".join(f"{p}:{ln}" for p, ln in sites)
-            if not EVENT_NAME_RE.match(name):
-                errors.append(
-                    f"{namespace} name {name!r} ({where}) is not "
-                    "lower_snake_case"
-                )
-            norm = name.replace("_", "").lower()
-            by_norm.setdefault(norm, {})[(namespace, name)] = sites
-    for norm, variants in sorted(by_norm.items()):
-        literals = {name for _, name in variants}
-        namespaces = {ns for ns, _ in variants}
-        where = "; ".join(
-            f"{ns} {name!r} at " + ", ".join(f"{p}:{ln}" for p, ln in sites)
-            for (ns, name), sites in sorted(variants.items())
-        )
-        if len(literals) > 1:
-            errors.append(
-                f"near-duplicate names {sorted(literals)} ({where}) — "
-                "case/underscore variants of one name"
-            )
-        elif len(namespaces) > 1:
-            errors.append(
-                f"{next(iter(literals))!r} used as both an event and a "
-                f"span phase ({where}) — one name, one meaning"
-            )
-    return errors
-
-
-def check(root: str) -> List[str]:
-    """All convention violations in ``root`` (empty list = clean)."""
-    errors: List[str] = []
-    regs = collect_registrations(root)
-    if not regs:
-        errors.append(
-            f"no metric registrations found under {root!r} — the scanner "
-            "or the telemetry wiring is broken"
-        )
-    for name, sites in sorted(regs.items()):
-        where = ", ".join(f"{p}:{ln}" for p, ln, _ in sites)
-        if not NAME_RE.match(name):
-            errors.append(
-                f"{name!r} ({where}) does not match "
-                "kafka_<subsystem>_<name>"
-            )
-        if len(sites) > 1:
-            errors.append(
-                f"{name!r} registered at {len(sites)} sites ({where}); "
-                "each metric must have exactly one owner"
-            )
-        kinds = {k for _, _, k in sites}
-        if len(kinds) > 1:
-            errors.append(
-                f"{name!r} registered as multiple kinds "
-                f"({sorted(kinds)}; {where})"
-            )
-    errors.extend(check_event_and_phase_names(root))
-    return errors
-
-
-def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    root = argv[0] if argv else os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))
-    )
-    errors = check(root)
-    regs = collect_registrations(root)
-    if errors:
-        for e in errors:
-            print(f"check_metric_names: {e}", file=sys.stderr)
-        return 1
-    events = collect_names(root, EMIT_RE)
-    phases = collect_names(root, SPAN_RE)
-    print(
-        f"check_metric_names: {len(regs)} metric names OK "
-        f"({sum(len(s) for s in regs.values())} registrations), "
-        f"{len(events)} event names, {len(phases)} span phases"
-    )
-    return 0
-
 
 if __name__ == "__main__":
     sys.exit(main())

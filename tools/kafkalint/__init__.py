@@ -1,0 +1,28 @@
+"""kafkalint — AST static analysis for JAX/TPU hazards and repo conventions.
+
+Run it with::
+
+    python -m tools.kafkalint [root] [--json] [--rules a,b] [--list-rules]
+
+Exit codes: 0 clean, 1 findings (or a stale baseline entry), 2 usage
+error.  See BASELINE.md "Static analysis" for the rule table, the
+``# kafkalint: disable=<rule>`` suppression syntax, and the baseline
+update flow; ``tests/test_lint.py`` wires the pass into tier-1.
+"""
+
+from .core import (  # noqa: F401
+    REGISTRY,
+    FileContext,
+    Finding,
+    LintResult,
+    Rule,
+    iter_files,
+    make_rules,
+    register,
+    run_lint,
+)
+
+__all__ = [
+    "REGISTRY", "FileContext", "Finding", "LintResult", "Rule",
+    "iter_files", "make_rules", "register", "run_lint",
+]

@@ -1,0 +1,90 @@
+"""kafkalint command line: human and --json output, stable exit codes."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .core import REGISTRY, make_rules, run_lint
+
+
+def _default_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    ))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.kafkalint",
+        description=(
+            "AST static analysis for JAX/TPU hazards and repo "
+            "conventions (BASELINE.md 'Static analysis')"
+        ),
+    )
+    p.add_argument("root", nargs="?", default=None,
+                   help="tree to lint (default: this repo)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable findings on stdout")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated subset of rules to run")
+    p.add_argument("--baseline", default=None,
+                   help="baseline JSON path (default: "
+                        "<root>/tools/kafkalint/baseline.json if present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the registered rules and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        make_rules()  # import rule modules so REGISTRY is populated
+        for name in sorted(REGISTRY):
+            print(f"{name}: {REGISTRY[name].description}")
+        return 0
+    root = args.root or _default_root()
+    if not os.path.isdir(root):
+        print(f"kafkalint: no such directory: {root}", file=sys.stderr)
+        return 2
+    rule_names = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules else None
+    )
+    try:
+        result = run_lint(
+            root, rule_names=rule_names, baseline_path=args.baseline,
+            use_baseline=not args.no_baseline,
+        )
+    except ValueError as exc:  # unknown rule / malformed baseline
+        print(f"kafkalint: {exc}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        payload = result.to_json()
+        payload["root"] = os.path.abspath(root)
+        json.dump(payload, sys.stdout, indent=2)
+        print()
+        return 0 if result.clean else 1
+    for f in result.findings:
+        print(f"kafkalint: {f.format()}", file=sys.stderr)
+    if result.findings:
+        print(
+            f"kafkalint: {len(result.findings)} finding(s) in "
+            f"{result.files_scanned} file(s)",
+            file=sys.stderr,
+        )
+        return 1
+    grandfathered = (
+        f", {result.baseline_matched} grandfathered"
+        if result.baseline_matched else ""
+    )
+    print(
+        f"kafkalint: clean ({result.files_scanned} files, "
+        f"{len(result.rules)} rules{grandfathered})"
+    )
+    return 0

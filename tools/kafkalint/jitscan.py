@@ -1,0 +1,264 @@
+"""Shared AST analysis: where does device code live in a module?
+
+A *jit region* is a function whose body is traced and runs on device:
+
+- defs decorated with ``jax.jit`` / ``pjit`` / ``pmap`` (directly or via
+  ``functools.partial(jax.jit, ...)``);
+- callables handed to ``jax.jit(...)`` / ``pjit(...)`` call forms;
+- Pallas kernels (first argument of ``pl.pallas_call``);
+- bodies of structured control flow: ``lax.scan`` / ``lax.map`` /
+  ``lax.while_loop`` / ``lax.fori_loop`` / ``lax.cond`` / ``lax.switch``;
+- anything lexically nested inside one of the above.
+
+Targets are resolved through ``functools.partial`` and the common
+transforms (``grad`` / ``value_and_grad`` / ``vmap`` / ``checkpoint``) to
+a ``Lambda`` or a same-module ``def`` by name; unresolvable targets
+(e.g. methods of instances built elsewhere) are skipped — this is a
+convention lint, not a soundness proof.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Tuple, Union
+
+FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+JIT_TAILS = {"jit", "pjit", "pmap"}
+TRANSFORM_TAILS = {"value_and_grad", "grad", "vmap", "checkpoint", "remat"}
+
+#: control-flow entry points -> indices of their callable arguments.
+#: ("rest1" = every positional arg from index 1 on, for cond/switch.)
+_BODY_ARGS = {
+    "scan": (0,),
+    "map": (0,),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "cond": "rest1",
+    "switch": "rest1",
+    "pallas_call": (0,),
+}
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``jax.lax.scan`` for the matching Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def tail(node: ast.AST) -> Optional[str]:
+    d = dotted(node)
+    return d.rsplit(".", 1)[-1] if d else None
+
+
+def collect_defs(tree: ast.AST) -> Dict[str, FuncNode]:
+    """Every named def in the module (methods included), by bare name.
+    Later defs shadow earlier same-named ones — good enough for
+    resolving ``target=`` / body-callable references."""
+    defs: Dict[str, FuncNode] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+    return defs
+
+
+def resolve_callable(node: ast.AST,
+                     defs: Dict[str, FuncNode]) -> List[FuncNode]:
+    """Resolve an expression used as a callable to def/lambda nodes."""
+    if isinstance(node, ast.Lambda):
+        return [node]
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        t = tail(node)
+        return [defs[t]] if t in defs else []
+    if isinstance(node, ast.Call) and node.args:
+        t = tail(node.func)
+        if t == "partial" or t in TRANSFORM_TAILS:
+            return resolve_callable(node.args[0], defs)
+    return []
+
+
+@dataclasses.dataclass
+class JitEntry:
+    """One jit region root.
+
+    ``func`` — the def/lambda whose body is device code.
+    ``via`` — how it became one (decorator / wrapping call / body-of).
+    ``static_argnums`` / ``static_argnames`` — only for jit-wrapped
+    entries whose statics were literal enough to read; None means "not a
+    jit wrapping" (control-flow bodies) and the static-arg rule skips it.
+    """
+
+    func: FuncNode
+    via: str
+    static_argnums: Optional[Tuple[int, ...]] = None
+    static_argnames: Optional[Tuple[str, ...]] = None
+    statics_known: bool = True
+
+    @property
+    def name(self) -> str:
+        return getattr(self.func, "name", "<lambda>")
+
+
+def _literal_statics(keywords) -> Tuple[Tuple[int, ...], Tuple[str, ...],
+                                        bool]:
+    """(static_argnums, static_argnames, fully-literal?) from jit kwargs."""
+    nums: Tuple[int, ...] = ()
+    names: Tuple[str, ...] = ()
+    known = True
+    for kw in keywords or ():
+        if kw.arg not in ("static_argnums", "static_argnames"):
+            continue
+        try:
+            val = ast.literal_eval(kw.value)
+        except (ValueError, SyntaxError):
+            known = False
+            continue
+        if isinstance(val, (int, str)):
+            val = (val,)
+        if kw.arg == "static_argnums":
+            nums = tuple(int(v) for v in val)
+        else:
+            names = tuple(str(v) for v in val)
+    return nums, names, known
+
+
+def _decorator_entry(fn: FuncNode) -> Optional[JitEntry]:
+    for d in getattr(fn, "decorator_list", ()):
+        if tail(d) in JIT_TAILS:
+            return JitEntry(fn, via=f"@{dotted(d)}",
+                            static_argnums=(), static_argnames=())
+        if isinstance(d, ast.Call):
+            t = tail(d.func)
+            if t in JIT_TAILS:
+                nums, names, known = _literal_statics(d.keywords)
+                return JitEntry(fn, via=f"@{dotted(d.func)}(...)",
+                                static_argnums=nums, static_argnames=names,
+                                statics_known=known)
+            if t == "partial" and d.args and tail(d.args[0]) in JIT_TAILS:
+                nums, names, known = _literal_statics(d.keywords)
+                return JitEntry(
+                    fn, via=f"@partial({dotted(d.args[0])}, ...)",
+                    static_argnums=nums, static_argnames=names,
+                    statics_known=known,
+                )
+    return None
+
+
+def _is_lax_call(func_node: ast.AST, t: str) -> bool:
+    """Guard bare-name collisions: ``map``/``cond``/... must be lax-
+    qualified; ``scan``/``pallas_call``/jit tails may appear bare."""
+    d = dotted(func_node) or ""
+    if t in ("map", "cond", "switch", "while_loop", "fori_loop"):
+        return ".".join(d.split(".")[:-1]).endswith("lax") or d == t and \
+            t in ("while_loop", "fori_loop")
+    return True
+
+
+def jit_entries(tree: ast.AST) -> List[JitEntry]:
+    """Every jit-region root in the module, decorator and call forms."""
+    defs = collect_defs(tree)
+    entries: List[JitEntry] = []
+    seen = set()
+
+    def add(func: FuncNode, **kw) -> None:
+        if id(func) not in seen:
+            seen.add(id(func))
+            entries.append(JitEntry(func, **kw))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            e = _decorator_entry(node)
+            if e is not None and id(node) not in seen:
+                seen.add(id(node))
+                entries.append(e)
+        elif isinstance(node, ast.Call):
+            t = tail(node.func)
+            if t in JIT_TAILS and node.args:
+                nums, names, known = _literal_statics(node.keywords)
+                for func in resolve_callable(node.args[0], defs):
+                    add(func, via=f"{dotted(node.func)}(...) call",
+                        static_argnums=nums, static_argnames=names,
+                        statics_known=known)
+            elif t in _BODY_ARGS and _is_lax_call(node.func, t):
+                spec = _BODY_ARGS[t]
+                idxs = (
+                    range(1, len(node.args)) if spec == "rest1" else spec
+                )
+                for i in idxs:
+                    if i < len(node.args):
+                        for func in resolve_callable(node.args[i], defs):
+                            add(func, via=f"body of {dotted(node.func)}")
+    return entries
+
+
+def region_locals(func: FuncNode) -> set:
+    """Names bound inside the region: parameters of the root and of every
+    nested def/lambda, plus local assignment/loop/with targets.  These are
+    the names a host-transfer call on which is (conservatively) a traced
+    value; closure reads from outside the region are not included."""
+    names: set = set()
+
+    def add_args(fn: FuncNode) -> None:
+        a = fn.args
+        for arg in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+            names.add(arg.arg)
+        if a.vararg:
+            names.add(a.vararg.arg)
+        if a.kwarg:
+            names.add(a.kwarg.arg)
+
+    add_args(func)
+    body = func.body if isinstance(func.body, list) else [func.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                add_args(node)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                                   ast.For, ast.AsyncFor, ast.NamedExpr)):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AnnAssign, ast.AugAssign,
+                                       ast.NamedExpr)):
+                    targets = [node.target]
+                else:
+                    targets = [node.target]
+                for t in targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name):
+                            names.add(sub.id)
+    return names
+
+
+def numpy_aliases(tree: ast.AST) -> set:
+    """Module-level names bound to the ``numpy`` package."""
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    aliases.add(a.asname or "numpy")
+    return aliases or {"np", "numpy"} & _names_used(tree)
+
+
+def jnp_aliases(tree: ast.AST) -> set:
+    """Module-level names bound to ``jax.numpy``."""
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.numpy":
+                    aliases.add(a.asname or "jax")
+    return aliases | {"jnp"}
+
+
+def _names_used(tree: ast.AST) -> set:
+    return {n.id for n in ast.walk(tree) if isinstance(n, ast.Name)}

@@ -1,0 +1,290 @@
+"""JAX/TPU hazard rules: host transfers in jit, implicit f64, static flags.
+
+These encode the engine's device-path conventions (BASELINE.md "Static
+analysis"): exactly one device->host read per window means NO hidden
+transfer may hide inside a jitted/scanned body; the 2e-3 fused-parity
+tolerance story holds only while device math stays float32; bool/str
+arguments of jitted functions must be static or every flag flip retraces.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from .core import FileContext, Finding, Rule, register
+from . import jitscan
+
+#: packages whose modules hold device code (traced math).  Everything
+#: else — io readers, cli drivers, telemetry, testing, tools — is host
+#: side, where float64 is often *correct* (geolocation, emulator import).
+DEVICE_PREFIXES = (
+    "kafka_tpu/core/",
+    "kafka_tpu/shard/",
+    "kafka_tpu/obsops/",
+    "kafka_tpu/engine/",
+)
+DEVICE_FILES = ("bench.py",)
+
+#: host-side modules inside device packages: f64 is deliberate there.
+HOST_ALLOWLIST = {
+    # Emulator import: K can be ill-conditioned, the solve is f64 on host
+    # and the bank is cast to f32 at the end (obsops/gp_import.py).
+    "kafka_tpu/obsops/gp_import.py",
+    # Published-spectra anchor tables, band-averaged once at import by
+    # plain numpy; never traced.
+    "kafka_tpu/obsops/prospect_data.py",
+    # Geolocation/warp math is host-side numpy where f64 precision is the
+    # point (sub-pixel UTM/sinusoidal transforms).
+    "kafka_tpu/io/warp.py",
+}
+
+
+def is_device_module(rel: str) -> bool:
+    if rel in HOST_ALLOWLIST:
+        return False
+    return rel in DEVICE_FILES or rel.startswith(DEVICE_PREFIXES)
+
+
+def _shielded(node: ast.AST, traced: set) -> bool:
+    """True when ``node`` reads no traced value: constants, or names only
+    reached through static accessors (``.shape``/``.ndim``/``.dtype``/
+    ``len()``) that trace-time Python evaluates to plain ints."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in (
+                "shape", "ndim", "dtype", "size"):
+            continue
+        if isinstance(sub, ast.Name) and sub.id in traced:
+            if not _under_static_accessor(node, sub):
+                return False
+    return True
+
+
+def _under_static_accessor(root: ast.AST, target: ast.Name) -> bool:
+    """Is ``target`` only reachable through a .shape/.ndim/.dtype
+    attribute or a len() call within ``root``?"""
+
+    class V(ast.NodeVisitor):
+        found_bare = False
+
+        def visit_Attribute(self, node: ast.Attribute) -> None:
+            if node.attr in ("shape", "ndim", "dtype", "size"):
+                return  # static at trace time; don't descend
+            self.generic_visit(node)
+
+        def visit_Call(self, node: ast.Call) -> None:
+            if isinstance(node.func, ast.Name) and node.func.id == "len":
+                return
+            self.generic_visit(node)
+
+        def visit_Name(self, node: ast.Name) -> None:
+            if node is target:
+                self.found_bare = True
+
+    v = V()
+    v.visit(root)
+    return not v.found_bare
+
+
+@register
+class HostTransferInJit(Rule):
+    name = "host-transfer-in-jit"
+    description = (
+        "np.* calls, float()/int()/.item() on traced values, and "
+        "device_get inside jitted/pallas/lax-control-flow bodies — each "
+        "is a hidden device->host transfer (or a silent constant fold) "
+        "that breaks the one-read-per-window budget"
+    )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return ()
+        entries = jitscan.jit_entries(ctx.tree)
+        if not entries:
+            return ()
+        np_names = jitscan.numpy_aliases(ctx.tree)
+        findings: List[Finding] = []
+        seen_lines = set()
+
+        def flag(node: ast.AST, what: str, region: str) -> None:
+            key = (node.lineno, what)
+            if key in seen_lines:
+                return
+            seen_lines.add(key)
+            findings.append(Finding(
+                path=ctx.rel, line=node.lineno, rule=self.name,
+                message=(
+                    f"{what} inside jit region '{region}' — a hidden "
+                    "device->host transfer (or silent constant fold); "
+                    "keep traced math in jnp and hoist host work out of "
+                    "the jitted/scanned body"
+                ),
+            ))
+
+        for entry in entries:
+            traced = jitscan.region_locals(entry.func)
+            body = entry.func.body
+            stmts = body if isinstance(body, list) else [body]
+            for stmt in stmts:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    f = node.func
+                    t = jitscan.tail(f)
+                    if (isinstance(f, ast.Attribute)
+                            and isinstance(f.value, ast.Name)
+                            and f.value.id in np_names):
+                        flag(node, f"{f.value.id}.{f.attr}()", entry.name)
+                    elif (isinstance(f, ast.Name)
+                          and f.id in ("float", "int")
+                          and node.args
+                          and not _shielded(node.args[0], traced)):
+                        flag(node, f"{f.id}() on a traced value",
+                             entry.name)
+                    elif isinstance(f, ast.Attribute) and f.attr == "item":
+                        flag(node, ".item()", entry.name)
+                    elif t == "device_get":
+                        flag(node, "device_get()", entry.name)
+        return findings
+
+
+@register
+class ImplicitF64(Rule):
+    name = "implicit-f64"
+    description = (
+        "float64 dtypes (np.float64/jnp.float64/'float64') and dtype-less "
+        "jnp.asarray of Python float literals in device-code modules — "
+        "device math is float32-only (the 2e-3 fused-parity budget); "
+        "host-side modules (io/warp.py, obsops/gp_import.py, ...) are "
+        "allowlisted because f64 is correct there"
+    )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None or not is_device_module(ctx.rel):
+            return ()
+        jnp_names = jitscan.jnp_aliases(ctx.tree)
+        findings: List[Finding] = []
+
+        def flag(node: ast.AST, msg: str) -> None:
+            findings.append(Finding(
+                path=ctx.rel, line=node.lineno, rule=self.name,
+                message=msg,
+            ))
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute) and node.attr == "float64":
+                base = jitscan.dotted(node.value) or "?"
+                flag(node, (
+                    f"{base}.float64 in a device-code module — device "
+                    "math is float32-only; compute in f32 or move this "
+                    "to a host-side module (allowlisted in "
+                    "tools/kafkalint/rules_jax.py)"
+                ))
+            elif isinstance(node, ast.Call):
+                for arg in (*node.args,
+                            *(kw.value for kw in node.keywords)):
+                    if (isinstance(arg, ast.Constant)
+                            and arg.value == "float64"):
+                        flag(arg, (
+                            "dtype \"float64\" in a device-code module "
+                            "— device math is float32-only"
+                        ))
+                self._check_asarray(node, jnp_names, flag)
+        return findings
+
+    @staticmethod
+    def _check_asarray(node: ast.Call, jnp_names: set, flag) -> None:
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in ("asarray", "array")
+                and isinstance(f.value, ast.Name)
+                and f.value.id in jnp_names):
+            return
+        if len(node.args) != 1 or any(
+                kw.arg == "dtype" for kw in node.keywords):
+            return
+        arg = node.args[0]
+        has_float = any(
+            isinstance(sub, ast.Constant) and isinstance(sub.value, float)
+            for sub in ast.walk(arg)
+        )
+        only_literals = all(
+            isinstance(sub, (ast.Constant, ast.List, ast.Tuple,
+                             ast.UnaryOp, ast.unaryop, ast.expr_context))
+            for sub in ast.walk(arg)
+        )
+        if has_float and only_literals:
+            flag(node, (
+                f"dtype-less {f.value.id}.{f.attr}() of a Python float "
+                "literal — promotes to f64 under jax_enable_x64; pass "
+                "an explicit jnp.float32"
+            ))
+
+
+@register
+class StaticArgFlag(Rule):
+    name = "static-arg-flag"
+    description = (
+        "bool/str parameters of jitted functions not named in "
+        "static_argnames/static_argnums — structural flags must be "
+        "static or every value change retraces (str args fail tracing "
+        "outright)"
+    )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return ()
+        findings: List[Finding] = []
+        for entry in jitscan.jit_entries(ctx.tree):
+            if entry.static_argnums is None or not entry.statics_known:
+                continue  # control-flow body, or non-literal statics
+            fn = entry.func
+            if isinstance(fn, ast.Lambda):
+                continue  # lambdas carry no annotations/defaults to read
+            a = fn.args
+            positional = [*a.posonlyargs, *a.args]
+            defaults = dict(zip(
+                [p.arg for p in positional[len(positional)
+                                           - len(a.defaults):]],
+                a.defaults,
+            ))
+            for kwarg, d in zip(a.kwonlyargs, a.kw_defaults):
+                if d is not None:
+                    defaults[kwarg.arg] = d
+            for idx, param in enumerate(positional + list(a.kwonlyargs)):
+                kind = _flag_kind(param, defaults.get(param.arg))
+                if kind is None:
+                    continue
+                covered = (
+                    param.arg in entry.static_argnames
+                    or (param in positional
+                        and idx in entry.static_argnums)
+                )
+                if not covered:
+                    findings.append(Finding(
+                        path=ctx.rel, line=param.lineno, rule=self.name,
+                        message=(
+                            f"parameter '{param.arg}' of jitted "
+                            f"'{entry.name}' ({entry.via}) is "
+                            f"{kind}-typed but not in static_argnames/"
+                            "static_argnums — structural flags must be "
+                            "static (str args fail tracing; bool args "
+                            "silently retrace per value)"
+                        ),
+                    ))
+        return findings
+
+
+def _flag_kind(param: ast.arg, default) -> str:
+    """'bool'/'str' when the parameter is annotated or defaulted as such."""
+    ann = param.annotation
+    if isinstance(ann, ast.Name) and ann.id in ("bool", "str"):
+        return ann.id
+    if isinstance(ann, ast.Constant) and ann.value in ("bool", "str"):
+        return ann.value
+    if isinstance(default, ast.Constant):
+        if isinstance(default.value, bool):
+            return "bool"
+        if isinstance(default.value, str):
+            return "str"
+    return None

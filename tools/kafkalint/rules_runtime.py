@@ -1,0 +1,186 @@
+"""Runtime-convention rules: thread trace propagation, exception hygiene.
+
+``untracked-thread`` encodes the PR 3 tracing convention: contextvars do
+NOT flow into new threads, so every thread owner captures
+``tracing.current_context()`` at construction and the thread target
+re-installs it with ``tracing.set_context(...)`` — otherwise the thread's
+spans detach from the run timeline (see ``engine/prefetch.py`` and
+``io/output.py`` for the canonical shape).
+
+``bare-except`` flags ``except:`` / ``except Exception:`` /
+``except BaseException:`` handlers that swallow the error: no re-raise,
+no logging (stdlib logger methods or registry ``emit``), and no
+justification comment.  The accepted justification form is a trailing
+comment on the ``except`` line (or a comment line opening the handler
+body) that says *why* swallowing is correct — kafkalint/expect directives
+and bare ``noqa`` codes do not count.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional
+
+from .core import FileContext, Finding, Rule, register
+from . import jitscan
+
+_LOG_ATTRS = {
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "log", "emit",
+}
+
+#: directives are machine syntax, not human justification.
+_DIRECTIVE_RE = re.compile(r"^\s*(kafkalint\s*:|expect\s*:)")
+_NOQA_RE = re.compile(r"noqa\s*:?\s*[A-Z0-9, ]*")
+
+
+@register
+class UntrackedThread(Rule):
+    name = "untracked-thread"
+    description = (
+        "threading.Thread spawns whose target does not re-install the "
+        "TraceContext (tracing.set_context) — contextvars don't cross "
+        "thread creation, so the thread's spans/events detach from the "
+        "run timeline"
+    )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return ()
+        defs = jitscan.collect_defs(ctx.tree)
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and jitscan.tail(node.func) == "Thread"):
+                continue
+            target = None
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+            if target is None and node.args:
+                # threading.Thread(group, target, ...) positional form.
+                target = node.args[1] if len(node.args) > 1 else None
+            if target is None:
+                findings.append(self._finding(
+                    ctx, node,
+                    "threading.Thread(...) with no resolvable target — "
+                    "cannot verify the TraceContext re-install",
+                ))
+                continue
+            resolved = jitscan.resolve_callable(target, defs)
+            if not resolved:
+                findings.append(self._finding(
+                    ctx, node,
+                    f"threading.Thread target "
+                    f"{ast.unparse(target)!r} is not resolvable in this "
+                    "module — cannot verify the TraceContext re-install",
+                ))
+                continue
+            for func in resolved:
+                if not self._installs_context(func):
+                    name = getattr(func, "name", "<lambda>")
+                    findings.append(self._finding(
+                        ctx, node,
+                        f"threading.Thread target '{name}' never calls "
+                        "tracing.set_context(...) — capture "
+                        "tracing.current_context() at construction and "
+                        "re-install it first thing in the target",
+                    ))
+        return findings
+
+    @staticmethod
+    def _installs_context(func) -> bool:
+        body = func.body if isinstance(func.body, list) else [func.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if (isinstance(node, ast.Call)
+                        and jitscan.tail(node.func) == "set_context"):
+                    return True
+        return False
+
+    def _finding(self, ctx: FileContext, node: ast.AST,
+                 msg: str) -> Finding:
+        return Finding(path=ctx.rel, line=node.lineno, rule=self.name,
+                       message=msg + " (PR 3 tracing convention; see "
+                               "engine/prefetch.py for the shape)")
+
+
+@register
+class BareExcept(Rule):
+    name = "bare-except"
+    description = (
+        "except:/except Exception: handlers with no re-raise, no "
+        "logging, and no justification comment — silent swallows hide "
+        "real failures; narrow the type, log it, or justify it inline"
+    )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return ()
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            caught = self._broad_catch(node.type)
+            if caught is None:
+                continue
+            if any(isinstance(n, ast.Raise)
+                   for stmt in node.body for n in ast.walk(stmt)):
+                continue
+            if self._logs(node):
+                continue
+            if self._justified(ctx, node):
+                continue
+            findings.append(Finding(
+                path=ctx.rel, line=node.lineno, rule=self.name,
+                message=(
+                    f"'except {caught}' swallows the error with no "
+                    "re-raise, no logging, and no justification comment "
+                    "— narrow the exception type, log through the "
+                    "registry/logger, or add a trailing '# <why this is "
+                    "safe>' comment"
+                ),
+            ))
+        return findings
+
+    @staticmethod
+    def _broad_catch(type_node) -> Optional[str]:
+        if type_node is None:
+            return ""
+        names = []
+        nodes = (type_node.elts if isinstance(type_node, ast.Tuple)
+                 else [type_node])
+        for n in nodes:
+            names.append(jitscan.tail(n) or "?")
+        broad = [n for n in names if n in ("Exception", "BaseException")]
+        return broad[0] if broad else None
+
+    @staticmethod
+    def _logs(handler: ast.ExceptHandler) -> bool:
+        for stmt in handler.body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr in _LOG_ATTRS:
+                    return True
+        return False
+
+    @staticmethod
+    def _justified(ctx: FileContext, handler: ast.ExceptHandler) -> bool:
+        """A human reason on the except line, between it and the first
+        body statement, or trailing the first body line."""
+        first_body = handler.body[0].lineno if handler.body else \
+            handler.lineno
+        for lineno in range(handler.lineno, first_body + 1):
+            line = ctx.line_text(lineno)
+            if "#" not in line:
+                continue
+            comment = line.split("#", 1)[1]
+            if _DIRECTIVE_RE.match(comment):
+                continue
+            stripped = _NOQA_RE.sub("", comment)
+            if re.search(r"[A-Za-z]{2}", stripped):
+                return True
+        return False

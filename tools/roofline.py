@@ -72,7 +72,7 @@ def cost_of(compiled):
     # keeps the measured-ms row while dropping the model-derived columns.
     try:
         ca = compiled.cost_analysis()
-    except Exception:
+    except Exception:  # backend-specific raise on custom-call HLO
         return float("nan"), float("nan")
     if isinstance(ca, (list, tuple)):
         ca = ca[0]
