@@ -13,9 +13,11 @@ jitted batched-dense TPU path.
 Prints ONE JSON line:
     {"metric": "assimilation_throughput", "value": <device px/s>,
      "unit": "pixels/sec", "vs_baseline": <speedup over SciPy CPU>, ...}
-plus (a) the fused-Pallas device row (``device_pallas_ms`` vs
+plus (a) the fused-Pallas device rows (``device_pallas_ms`` vs
 ``device_xla_ms`` at 2^19 px — the BASELINE.md "Roofline" pair, ~3.8 vs
-~6.4 ms on a healthy v5e; null off-TPU where interpret-mode timings would
+~6.4 ms on a healthy v5e — and ``device_pallas_fused_lin_ms``, the
+in-kernel-linearise generation that keeps the whole Gauss-Newton loop
+VMEM-resident; all null off-TPU where interpret-mode timings would
 be fiction) and (b) the bench health layer (``probe_device_ms``,
 ``probe_host_ms``, ``unhealthy`` — see ``probe_health``), which exists
 because rounds 3-5 archived 35.7k/72.8k/44.0k e2e px-steps/s with no code
@@ -56,7 +58,8 @@ from kafka_tpu.telemetry.health import (  # noqa: F401 — bench API re-export
 )
 
 
-def bench_device_sizes(sizes, ks=(5, 25), use_pallas=False):
+def bench_device_sizes(sizes, ks=(5, 25), use_pallas=False,
+                       inkernel_linearize=None):
     """Jitted batched-dense iterated solve on the default JAX device.
 
     Measurement methodology (matters on a tunneled TPU): before the first
@@ -77,7 +80,12 @@ def bench_device_sizes(sizes, ks=(5, 25), use_pallas=False):
     ``use_pallas`` measures the fused VMEM-resident Pallas path instead
     of the XLA-fused one — the same jitted GN loop with the per-date
     update as ONE kernel launch (BASELINE.md "Roofline": 6.45 -> 3.80 ms
-    at 2^19 px on a healthy v5e window).
+    at 2^19 px on a healthy v5e window).  ``inkernel_linearize`` pins the
+    solver's same-named static flag so the two kernel generations stay
+    separable rows: False = the PR 1 whole-update kernel (out-of-kernel
+    linearise, ``device_pallas_ms``), True = the in-kernel Gauss-Newton
+    path (``device_pallas_fused_lin_ms`` — linearisation, iteration carry
+    and packed A all VMEM-resident).
     """
     import jax
     import jax.numpy as jnp
@@ -99,6 +107,8 @@ def bench_device_sizes(sizes, ks=(5, 25), use_pallas=False):
         )}
         if use_pallas:
             opts["use_pallas"] = True
+        if inkernel_linearize is not None:
+            opts["inkernel_linearize"] = bool(inkernel_linearize)
         args = (op.linearize, bands, x0, p_inv0, None, opts)
         x, p_inv, diags = assimilate_date_jit(*args)  # compile
         np.asarray(x[0][:1])  # flush
@@ -129,8 +139,11 @@ def bench_device_sizes(sizes, ks=(5, 25), use_pallas=False):
         ]
         slopes_by_size.setdefault(n_pix, []).extend(burst)
         dt = float(np.median(burst))
+        tag = "xla"
+        if use_pallas:
+            tag = "pallas+inlin" if inkernel_linearize else "pallas"
         print(
-            f"device[{'pallas' if use_pallas else 'xla'}]: {n_pix} px, "
+            f"device[{tag}]: {n_pix} px, "
             f"{int(diags.n_iterations)} GN iters, "
             f"{dt*1e3:.2f} ms/solve sustained on "
             f"{jax.devices()[0].platform}",
@@ -149,11 +162,17 @@ def bench_device_sizes(sizes, ks=(5, 25), use_pallas=False):
 def bench_oracle(n_pix: int, reps: int = 5):
     """The reference algorithm (sparse block-diag + SuperLU) on host CPU.
 
-    Median of ``reps`` runs with the spread reported: the single-shot CPU
-    baseline swung 6.7x between rounds (host-load noise), which put error
-    bars of the same size on the headline speedup.  Returns
-    ``(pixels_per_sec_median, median_ms, spread_ms)`` where spread is
-    (max - min) over the reps.
+    A WARM-UP solve runs before the timed reps: the first call pays
+    SuperLU's symbolic factorisation and lazy-import costs, which are
+    setup, not solve — BENCH_r05 recorded an ``oracle_ms_spread`` of
+    1922 ms against a 662 ms median because that first call sat inside
+    the timed window and dominated the spread.  Median of ``reps`` timed
+    runs with the spread AND the min reported (min-of-k is the classic
+    load-noise-robust statistic: host-load contamination only ever adds
+    time, so the minimum is the cleanest single observation and the
+    cross-round comparator ``tools/bench_compare.py`` consumers should
+    prefer when the spread is wide).  Returns
+    ``(pixels_per_sec_median, median_ms, spread_ms, min_ms)``.
     """
     import jax
 
@@ -185,6 +204,9 @@ def bench_oracle(n_pix: int, reps: int = 5):
 
     x0_np = np.asarray(x0)
     p_inv_np = np.asarray(p_inv0)
+    # Untimed warm-up: symbolic factorisation + imports happen here, not
+    # inside the first timed rep (see docstring).
+    iterated_sparse_solve(linearize, y_b, r_b, m_b, x0_np, p_inv_np)
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -194,12 +216,14 @@ def bench_oracle(n_pix: int, reps: int = 5):
         times.append(time.perf_counter() - t0)
     dt = float(np.median(times))
     spread = float(max(times) - min(times))
+    best = float(min(times))
     print(
         f"oracle: {n_pix} px, {n_iters} GN iters, {dt*1e3:.1f} ms/solve "
-        f"median of {reps} (spread {spread*1e3:.1f} ms, SciPy SuperLU)",
+        f"median of {reps} warm (spread {spread*1e3:.1f} ms, "
+        f"min {best*1e3:.1f} ms, SciPy SuperLU)",
         file=sys.stderr,
     )
-    return n_pix / dt, dt * 1e3, spread * 1e3
+    return n_pix / dt, dt * 1e3, spread * 1e3, best * 1e3
 
 
 def bench_end_to_end(ny: int = 204, nx: int = 235, n_dates: int = 3,
@@ -299,12 +323,13 @@ def bench_end_to_end(ny: int = 204, nx: int = 235, n_dates: int = 3,
 def assemble_result(
     health: dict,
     *,
-    oracle,                # (px_s, ms_median, ms_spread) @ n_matched
+    oracle,                # (px_s, ms_median, ms_spread, ms_min) @ n_matched
     device_matched,        # (px_s, ms_median, ms_spread) @ n_matched
     device,                # (px_s, ms_median, ms_spread) @ n_device
     pallas,                # same triple or None (off-TPU)
     e2e,                   # (px_steps_s, device_fraction, n_pixels)
     host_after_ms: float,
+    fused_lin=None,        # (px_s, ms_median, ms_spread) or None (off-TPU)
     n_matched: int = 16384,
     n_device: int = 1 << 19,
     registry=None,
@@ -318,11 +343,13 @@ def assemble_result(
     ``telemetry`` embeds the registry's compact counter/gauge snapshot
     (including the health gauges the probes recorded).
     """
-    base_px_s, oracle_ms, oracle_spread_ms = oracle
+    base_px_s, oracle_ms, oracle_spread_ms, oracle_min_ms = oracle
     dev_matched_px_s, matched_ms, matched_spread_ms = device_matched
     dev_px_s, xla_ms, xla_spread_ms = device
     pallas_px_s, pallas_ms, pallas_spread_ms = \
         pallas if pallas is not None else (None, None, None)
+    fl_px_s, fl_ms, fl_spread_ms = \
+        fused_lin if fused_lin is not None else (None, None, None)
     e2e_px_steps_s, device_frac, e2e_pix = e2e
     reg = registry if registry is not None else get_registry()
     # Close the health bracket: a window that degraded DURING the run is
@@ -345,6 +372,12 @@ def assemble_result(
         "vs_baseline_at_scale": round(dev_px_s / base_px_s, 2),
         "oracle_ms_median": round(oracle_ms, 1),
         "oracle_ms_spread": round(oracle_spread_ms, 1),
+        # Min-of-k over the WARM reps (first-call SuperLU symbolic
+        # factorisation excluded by a warm-up solve): host-load noise
+        # only ever ADDS time, so the min is the robust cross-round
+        # comparator when the spread is wide (BENCH_r05: 1922 ms spread
+        # was first-call cost, not solve variance).
+        "oracle_ms_min": round(oracle_min_ms, 1),
         "n_pix_device": n_device,
         "n_pix_matched": n_matched,
         "device_px_s_matched": round(dev_matched_px_s, 1),
@@ -361,6 +394,18 @@ def assemble_result(
         else round(pallas_spread_ms, 3),
         "device_pallas_px_s": None if pallas_px_s is None
         else round(pallas_px_s, 1),
+        # Third-generation row: the WHOLE Gauss-Newton loop (analytic
+        # in-kernel linearisation, VMEM-resident carry) as one launch —
+        # null off-TPU, and null for problems whose operator does not
+        # advertise inkernel_linearize.  Acceptance for the in-kernel
+        # path is this row strictly below device_pallas_ms on a
+        # healthy-window artifact.
+        "device_pallas_fused_lin_ms": None if fl_ms is None
+        else round(fl_ms, 3),
+        "device_pallas_fused_lin_ms_spread": None if fl_spread_ms is None
+        else round(fl_spread_ms, 3),
+        "device_pallas_fused_lin_px_s": None if fl_px_s is None
+        else round(fl_px_s, 1),
         "e2e_pixel_steps_per_s": round(e2e_px_steps_s, 1),
         "e2e_device_fraction": round(device_frac, 3),
         "e2e_n_pixels": e2e_pix,
@@ -408,19 +453,30 @@ def _bench_rows():
     # with both sizes reported.
     n_matched = 16384
     n_device = 1 << 19
-    base_px_s, oracle_ms, oracle_spread_ms = bench_oracle(n_matched)
+    oracle = bench_oracle(n_matched)
     # The matched size measures in two bursts bracketing the large-size
     # run: the tunnel's per-dispatch overhead drifts at minute scale, and
     # the pooled median (+ reported spread) bounds that drift's effect
     # on the headline speedup.
     dev = bench_device_sizes([n_matched, n_device, n_matched])
-    # The fused-Pallas row, first-class next to the XLA one.  Real-chip
+    # The fused-Pallas rows, first-class next to the XLA one.  Real-chip
     # only: the CPU interpreter times the Pallas INTERPRETER, not the
-    # kernel, and archiving that as a perf row would be fiction.
-    pallas = None
+    # kernel, and archiving that as a perf row would be fiction.  Two
+    # kernel generations measured separately: device_pallas_ms pins
+    # inkernel_linearize=False (the PR 1 whole-update kernel, Jacobian
+    # relayout + while_loop carry still crossing HBM) so the new
+    # device_pallas_fused_lin_ms row (whole GN loop in-kernel) is an
+    # apples-to-apples delta against it.
+    pallas = fused_lin = None
     if jax.default_backend() == "tpu":
-        dev_pl = bench_device_sizes([n_device], use_pallas=True)
+        dev_pl = bench_device_sizes(
+            [n_device], use_pallas=True, inkernel_linearize=False
+        )
         pallas = dev_pl[n_device]
+        dev_fl = bench_device_sizes(
+            [n_device], use_pallas=True, inkernel_linearize=True
+        )
+        fused_lin = dev_fl[n_device]
     else:
         print(
             "device[pallas]: skipped — no TPU (interpret-mode timings "
@@ -431,10 +487,11 @@ def _bench_rows():
     host_after_ms = probe_host()
     print(json.dumps(assemble_result(
         health,
-        oracle=(base_px_s, oracle_ms, oracle_spread_ms),
+        oracle=oracle,
         device_matched=dev[n_matched],
         device=dev[n_device],
         pallas=pallas,
+        fused_lin=fused_lin,
         e2e=e2e,
         host_after_ms=host_after_ms,
         n_matched=n_matched,

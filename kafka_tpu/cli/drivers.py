@@ -127,7 +127,10 @@ def run_one_chunk(
         state_propagation=cfg.make_propagator(),
         prior=prior,
         pad_multiple=cfg.pad_multiple,
-        solver_options=cfg.solver_options,
+        # Production defaults applied (use_pallas flips on for
+        # parity-tested operators once the healthy-window bench artifact
+        # exists — engine/config.py: resolved_solver_options).
+        solver_options=cfg.resolved_solver_options(),
         hessian_correction=cfg.hessian_correction,
         prefetch_depth=cfg.prefetch_depth,
         prefetch_workers=cfg.prefetch_workers,

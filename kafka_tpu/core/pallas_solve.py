@@ -14,14 +14,24 @@ results.  Layout contract: coefficient ``(i, j)`` with ``j <= i`` of the
 lower triangle lives at row ``i (i + 1) / 2 + j``, matching
 ``linalg.cholesky_packed``'s list-of-lists ordering.
 
-Two generations of kernel live here.  ``solve_rows`` (factor+solve only)
-was the first: measured 21.3 ms/solve vs 19.4 ms for the XLA path on the
-full GN loop — XLA's automatic fusion already near-optimal for that
-slice, so it stayed opt-in.  ``_fused_update_rows`` fuses the WHOLE
+Three generations of kernel live here.  ``solve_rows`` (factor+solve
+only) was the first: measured 21.3 ms/solve vs 19.4 ms for the XLA path
+on the full GN loop — XLA's automatic fusion already near-optimal for
+that slice, so it stayed opt-in.  ``_fused_update_rows`` fuses the WHOLE
 per-date update (assembly + factor + solve + innovations) into one
 launch; on a real v5e (TIP, 2^19 px, full 2-iteration GN loop,
 queued-slope timing) it takes the solve from 6.45 ms to 3.80 ms (~1.7x).
-The single measured story lives in BASELINE.md's "Roofline" section.
+``_fused_gn_kernel`` goes the rest of the way for operators that
+advertise an in-kernel analytic linearisation
+(``ObservationModel.inkernel_linearize``): the ENTIRE Gauss-Newton
+iteration — linearise, assemble, factor, solve, damp, project, converge
+— runs as one launch, with the state, packed information matrix and
+diagnostics block-resident in VMEM across iterations.  That deletes all
+three HBM round-trips BASELINE.md's "Roofline" gap attribution charges
+to the 3.80 ms path: the ``(B, n, p) -> (B*p, n)`` Jacobian relayout
+(the Jacobian never materialises at all), the ``lax.while_loop`` carry,
+and the separate bandwidth-bound operator-linearize program.  The single
+measured story lives in BASELINE.md's "Roofline" section.
 """
 
 from __future__ import annotations
@@ -32,12 +42,30 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from .linalg import cholesky_packed, solve_chol_vectors
 
 
 def tri_rows(p: int) -> int:
     return p * (p + 1) // 2
+
+
+def jac_to_rows(jac: jnp.ndarray) -> jnp.ndarray:
+    """The SANCTIONED ``(B, n, p) -> (B*p, n)`` Jacobian relayout.
+
+    Operators without an in-kernel linearisation (GP banks, PROSAIL, any
+    plain ``linearize`` closure) still produce the dense Jacobian batch
+    and pay this one extra HBM pass to reach the kernel's lane-row
+    layout.  It is the ONLY place in ``core/`` allowed to relayout a
+    Jacobian (kafkalint rule ``kernel-relayout`` flags any other): the
+    in-kernel path (``fused_gn_rows``) exists precisely so that operators
+    advertising ``inkernel_linearize`` never materialise the tensor —
+    their ``jac_rows`` are born in lane layout inside the kernel.
+    """
+    n_bands, n, p = jac.shape
+    # kafkalint: disable=kernel-relayout — this IS the sanctioned shim
+    return jnp.moveaxis(jac, 2, 1).reshape(n_bands * p, n)
 
 
 def _solve_kernel(p: int, a_ref, b_ref, x_ref):
@@ -204,6 +232,219 @@ def _fused_update_rows(jac_rows, h0, y, w, m, xl_rows, xf_rows, pf_rows,
     return x_rows, a_rows, inn_rows
 
 
+def _fused_gn_kernel(p: int, n_bands: int, min_iters: int, max_iters: int,
+                     has_bounds: bool, lin_rows,
+                     y_ref, w_ref, m_ref, xf_ref, pf_ref, scal_ref, bnd_ref,
+                     x_ref, a_ref, fwd_ref, inn_ref, st_ref):
+    """One pixel block of the WHOLE per-date Gauss-Newton solve.
+
+    Per iteration (the body of ``gn_step``, the exact math of
+    ``_fused_update_kernel`` with the linearisation inlined):
+
+        H0, J = lin_rows(x)                       (analytic, in-VMEM)
+        y~    = where(mask, y + J x - H0, 0)
+        A     = sum_b w_b J_b J_b^T + P_f^-1      (packed lower triangle)
+        x*    = A^-1 (sum_b w_b y~_b J_b + P_f^-1 x_f)
+        x     <- clip(x + relaxation (x* - x), lo, hi)
+
+    iterated as a bounded ``fori_loop`` over ``max_iters`` whose body is
+    skipped (``lax.cond``) once the block converged — the early-exit norm
+    check of the reference's while loop, folded into the convergence
+    diagnostics instead of a loop carrier crossing HBM.  State, packed
+    ``A``, fwd/innovation diagnostics and the iteration counters all stay
+    block-resident across iterations; the Jacobian lane rows are BORN in
+    kernel registers and never exist in HBM at all.
+
+    Convergence is block-local: ``||dx_block||^2 < thresh_sq`` where
+    ``thresh_sq = (tol * numel * block/n)^2`` applies the caller's
+    per-element normalisation to this block's share — the same test the
+    global loop applies, restricted to the block (a refinement: every
+    block satisfying it implies the global norm does too).  Iterations
+    match the while-loop semantics exactly when the batch is one block
+    (every tier-1 parity problem) and agree within the GN tolerance ball
+    otherwise.
+
+    ``lin_rows`` maps a tuple of p state lane vectors to ``(h0, jac)``
+    lists with ``jac[b][k]`` already a lane row (the
+    ``ObservationModel.kernel_linearize_rows`` contract).  ``scal_ref``
+    (SMEM) carries [relaxation, thresh_sq]; ``bnd_ref`` (SMEM, (2, p))
+    the per-parameter bounds.  ``st_ref`` row 0 broadcasts the block's
+    executed iteration count, row 1 its final squared step norm.
+    """
+
+    def idx(i, j):
+        return i * (i + 1) // 2 + j
+
+    relax = scal_ref[0]
+    thresh_sq = scal_ref[1]
+    xf = tuple(xf_ref[k, :] for k in range(p))
+    y = tuple(y_ref[b, :] for b in range(n_bands))
+    w = tuple(w_ref[b, :] for b in range(n_bands))
+    msk = tuple(m_ref[b, :] > 0 for b in range(n_bands))
+    pf = tuple(pf_ref[r, :] for r in range(tri_rows(p)))
+
+    def gn_step(carry):
+        x = carry[0]
+        n_done = carry[4]
+        h0, jac = lin_rows(x)
+        # y~ = where(mask, y + J x - H0, 0): select, NOT mask
+        # multiplication — masked-out positions hold NaN nodata
+        # (io/warp.py default) and 0 * NaN = NaN would poison the solve.
+        y_t = []
+        for b in range(n_bands):
+            jx = jac[b][0] * x[0]
+            for k in range(1, p):
+                jx = jx + jac[b][k] * x[k]
+            y_t.append(jnp.where(msk[b], y[b] + jx - h0[b], 0.0))
+        wj = [[w[b] * jac[b][i] for i in range(p)] for b in range(n_bands)]
+        a_pk = [[None] * p for _ in range(p)]
+        for i in range(p):
+            for j in range(i + 1):
+                s = pf[idx(i, j)]
+                for b in range(n_bands):
+                    s = s + wj[b][i] * jac[b][j]
+                a_pk[i][j] = a_pk[j][i] = s
+        rhs = []
+        for i in range(p):
+            s = pf[idx(i, 0)] * xf[0]
+            for q in range(1, p):
+                s = s + pf[idx(max(i, q), min(i, q))] * xf[q]
+            for b in range(n_bands):
+                s = s + wj[b][i] * y_t[b]
+            rhs.append(s)
+        l = cholesky_packed(a_pk)
+        x_raw = solve_chol_vectors(l, rhs)
+        # Damped step + physical-domain projection, identical to the
+        # while-loop body (core/solvers.py).
+        x_new = [x[k] + relax * (x_raw[k] - x[k]) for k in range(p)]
+        if has_bounds:
+            x_new = [
+                jnp.clip(x_new[k], bnd_ref[0, k], bnd_ref[1, k])
+                for k in range(p)
+            ]
+        # fwd = J (x_new - x_f) + H0 with the damped/projected iterate
+        # (reference solvers.py:70-71,135-136); innovations = y - H0
+        # under the mask (:139-142).  Both from the LIVE linearisation —
+        # no jac/h0 in the carry.
+        fwd = []
+        for b in range(n_bands):
+            s = jac[b][0] * (x_new[0] - xf[0])
+            for k in range(1, p):
+                s = s + jac[b][k] * (x_new[k] - xf[k])
+            fwd.append(s + h0[b])
+        inn = [
+            jnp.where(msk[b], y[b] - h0[b], 0.0) for b in range(n_bands)
+        ]
+        normsq = sum(jnp.sum((x_new[k] - x[k]) ** 2) for k in range(p))
+        a_rows = tuple(a_pk[i][j] for i in range(p) for j in range(i + 1))
+        return (tuple(x_new), a_rows, tuple(fwd), tuple(inn),
+                n_done + 1, normsq)
+
+    def body(_i, carry):
+        n_done, normsq = carry[4], carry[5]
+        converged = (normsq < thresh_sq) & (n_done >= min_iters)
+        return jax.lax.cond(converged, lambda c: c, gn_step, carry)
+
+    zero = jnp.zeros_like(xf[0])
+    carry0 = (
+        xf,
+        tuple(zero for _ in range(tri_rows(p))),
+        tuple(zero for _ in range(n_bands)),
+        tuple(zero for _ in range(n_bands)),
+        jnp.zeros((), jnp.int32),
+        jnp.full((), jnp.inf, jnp.float32),
+    )
+    # Bound max_iters + 1 reproduces the while loop's post-increment cap
+    # check (n_done > max_iterations): 26 solves at the reference's cap.
+    x, a_rows, fwd, inn, n_done, normsq = jax.lax.fori_loop(
+        0, max_iters + 1, body, carry0
+    )
+    for k in range(p):
+        x_ref[k, :] = x[k]
+    for r in range(tri_rows(p)):
+        a_ref[r, :] = a_rows[r]
+    for b in range(n_bands):
+        fwd_ref[b, :] = fwd[b]
+        inn_ref[b, :] = inn[b]
+    st_ref[0, :] = zero + n_done.astype(jnp.float32)
+    st_ref[1, :] = zero + normsq
+
+
+def fused_gn_rows(lin_rows, y, r_inv, mask_f, xf_rows, pf_rows,
+                  tol, min_iterations: int, max_iterations: int,
+                  relaxation, state_bounds_rows, norm_denominator,
+                  block: int = 2048, interpret: bool = None):
+    """Whole Gauss-Newton solve as ONE kernel launch per block.
+
+    Row-layout driver around :func:`_fused_gn_kernel`.  ``lin_rows`` is
+    the operator's bound ``kernel_linearize_rows`` (a stable callable —
+    the jit cache keys on it); ``state_bounds_rows`` is ``None`` or a
+    ``(lo, hi)`` pair broadcastable to ``(p,)``.  Returns
+    ``(x_rows, a_rows, fwd, inn, n_done, norm)`` where ``n_done`` is the
+    max executed iteration count over blocks and ``norm`` the global
+    final-step norm assembled from the per-block diagnostics.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    f32 = jnp.float32
+    n_coeff, n = pf_rows.shape
+    p = xf_rows.shape[0]
+    n_bands = y.shape[0]
+    if tri_rows(p) != n_coeff:
+        raise ValueError(f"{n_coeff} coefficient rows for p={p}")
+    block = math.gcd(n, min(block, n))
+    numel = jnp.asarray(norm_denominator, f32)
+    # Block-local share of the global convergence test (see kernel doc).
+    thresh = jnp.asarray(tol, f32) * numel * (block / n)
+    scal = jnp.stack([jnp.asarray(relaxation, f32), thresh * thresh])
+    has_bounds = state_bounds_rows is not None
+    if has_bounds:
+        lo, hi = state_bounds_rows
+        bnd = jnp.stack([
+            jnp.broadcast_to(jnp.asarray(lo, f32), (p,)),
+            jnp.broadcast_to(jnp.asarray(hi, f32), (p,)),
+        ])
+    else:
+        bnd = jnp.zeros((2, p), f32)
+
+    def spec(rows):
+        return pl.BlockSpec((rows, block), lambda i: (0, i))
+
+    x_rows, a_rows, fwd, inn, st = pl.pallas_call(
+        functools.partial(
+            _fused_gn_kernel, p, n_bands, int(min_iterations),
+            int(max_iterations), has_bounds, lin_rows,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((p, n), f32),
+            jax.ShapeDtypeStruct((n_coeff, n), f32),
+            jax.ShapeDtypeStruct((n_bands, n), f32),
+            jax.ShapeDtypeStruct((n_bands, n), f32),
+            jax.ShapeDtypeStruct((2, n), f32),
+        ),
+        grid=(n // block,),
+        in_specs=[
+            spec(n_bands), spec(n_bands), spec(n_bands),
+            spec(p), spec(n_coeff),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=(
+            spec(p), spec(n_coeff), spec(n_bands), spec(n_bands), spec(2),
+        ),
+        interpret=bool(interpret),
+    )(
+        y.astype(f32), r_inv.astype(f32), mask_f.astype(f32),
+        xf_rows.astype(f32), pf_rows.astype(f32), scal, bnd,
+    )
+    # Per-block diagnostics ride the st rows broadcast over their block:
+    # column 0 of each block carries the block's value.
+    per_block = st[:, ::block]
+    n_done = jnp.max(per_block[0]).astype(jnp.int32)
+    norm = jnp.sqrt(jnp.sum(per_block[1])) / numel
+    return x_rows, a_rows, fwd, inn, n_done, norm
+
+
 def fused_update_pallas(lin, obs, x_lin: jnp.ndarray,
                         x_forecast: jnp.ndarray,
                         p_inv_forecast: jnp.ndarray,
@@ -218,10 +459,10 @@ def fused_update_pallas(lin, obs, x_lin: jnp.ndarray,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     n_bands, n, p = lin.jac.shape
-    # (B, n, p) -> (B*p, n): row-major lane layout for the kernel.  This
-    # relayout is the one extra HBM pass the fused path pays (the dense
-    # carry/fusion round-trips it replaces cost ~10x more).
-    jac_rows = jnp.moveaxis(lin.jac, 2, 1).reshape(n_bands * p, n)
+    # (B, n, p) -> (B*p, n): the sanctioned compat-shim relayout — the
+    # one extra HBM pass the out-of-kernel-linearise path pays (the
+    # in-kernel path, fused_gn_rows, pays none).
+    jac_rows = jac_to_rows(lin.jac)
     if isinstance(p_inv_forecast, jnp.ndarray) and p_inv_forecast.ndim == 2:
         pf_rows = p_inv_forecast
     else:

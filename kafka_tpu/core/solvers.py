@@ -202,6 +202,23 @@ def kalman_update(
     return solve_spd_batched(a, b), a
 
 
+def _kernel_bounds_rows(state_bounds, p: int):
+    """Classify ``state_bounds`` for the in-kernel Gauss-Newton path.
+
+    Returns ``None`` (no bounds), the ``(lo, hi)`` pair when both sides
+    broadcast to per-parameter ``(p,)`` vectors (scalars included), or
+    ``False`` when the bounds need the out-of-kernel row loop (per-pixel
+    ``(n_pix, p)`` arrays — the kernel keeps bounds in SMEM, one scalar
+    pair per parameter)."""
+    if state_bounds is None:
+        return None
+    for v in state_bounds:
+        v = jnp.asarray(v)
+        if v.ndim > 1 or (v.ndim == 1 and v.shape[0] != p):
+            return False
+    return state_bounds
+
+
 def _iterated_solve_rows(
     linearize: LinearizeFn,
     obs: BandBatch,
@@ -215,6 +232,7 @@ def _iterated_solve_rows(
     state_bounds: Any,
     norm_denominator: Any,
     linearize_block: Any,
+    inkernel_linearize: bool = True,
 ):
     """Row-layout Gauss-Newton loop around the fused Pallas update.
 
@@ -234,11 +252,24 @@ def _iterated_solve_rows(
 
     Measured at p=7, 2 bands, 2^19 px on a v5e (queued-slope method):
     6.45 ms -> 3.80 ms for the full 2-iteration solve, a ~1.7x speedup
-    over the XLA-fused path.  Still well above the ~0.3 ms fusion-perfect
-    traffic bound — the remaining gap is the Jacobian relayout and the
-    while_loop carry, not the kernel (see BASELINE.md "Roofline").
+    over the XLA-fused path — still above the fusion-perfect traffic
+    bound because the Jacobian relayout, the while_loop carry and the
+    separate linearize program all cross HBM (BASELINE.md "Roofline").
+
+    When the operator advertises an in-kernel analytic linearisation
+    (``ObservationModel.inkernel_linearize`` + ``kernel_linearize_rows``)
+    and ``inkernel_linearize`` is not opted out, the ENTIRE loop instead
+    runs inside ``pallas_solve.fused_gn_rows`` — one launch, all three
+    round-trips deleted.  Engagement requires structural compatibility:
+    global-norm mode (checked by the caller), per-parameter bounds (see
+    ``_kernel_bounds_rows``), static iteration bounds, and an empty
+    operator-params pytree (the in-kernel operators are closed-form;
+    per-date aux stays on the out-of-kernel path).  ``linearize_block``
+    is irrelevant in-kernel — it bounds the out-of-kernel batched
+    jacfwd's peak memory, while the kernel is O(block) by construction.
     """
-    from .pallas_solve import _fused_update_rows, tri_rows
+    from .pallas_solve import _fused_update_rows, fused_gn_rows, \
+        jac_to_rows, tri_rows
 
     interpret = jax.default_backend() != "tpu"
     f32 = jnp.float32
@@ -255,6 +286,35 @@ def _iterated_solve_rows(
         ]
     )
     mask_f = obs.mask.astype(f32)
+
+    owner = getattr(linearize, "__self__", None)
+    kernel_bounds = _kernel_bounds_rows(state_bounds, p)
+    params_empty = (
+        operator_params is None or not jax.tree.leaves(operator_params)
+    )
+    if (
+        inkernel_linearize
+        and owner is not None
+        and getattr(owner, "inkernel_linearize", False)
+        and params_empty
+        and isinstance(min_iterations, int)
+        and isinstance(max_iterations, int)
+        and kernel_bounds is not False
+    ):
+        x_rows, a_rows, fwd, inn, n_done, norm = fused_gn_rows(
+            owner.kernel_linearize_rows, obs.y, obs.r_inv, mask_f,
+            xf_rows, pf_rows, tol, min_iterations, max_iterations,
+            relaxation, kernel_bounds, numel, interpret=interpret,
+        )
+        a_packed = [[None] * p for _ in range(p)]
+        for i in range(p):
+            for j in range(i + 1):
+                a_packed[i][j] = a_packed[j][i] = \
+                    a_rows[i * (i + 1) // 2 + j]
+        return (
+            x_rows.T, unpack_symmetric(a_packed), fwd, inn, n_done, norm
+        )
+
     use_block = (
         linearize_block is not None and 0 < linearize_block < n_pix
     )
@@ -267,9 +327,7 @@ def _iterated_solve_rows(
             )
         else:
             lin = _call_linearize(linearize, operator_params, x_cols)
-        jac_rows = jnp.moveaxis(lin.jac.astype(f32), 2, 1).reshape(
-            n_bands * p, n_pix
-        )
+        jac_rows = jac_to_rows(lin.jac.astype(f32))
         x_raw, a_rows, inn = _fused_update_rows(
             jac_rows, lin.h0, obs.y, obs.r_inv, mask_f,
             x_rows, xf_rows, pf_rows, 2048, interpret
@@ -364,6 +422,7 @@ def iterated_solve(
     linearize_block: Any = None,
     use_pallas: bool = False,
     per_pixel_convergence: bool = False,
+    inkernel_linearize: bool = True,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, SolveDiagnostics]:
     """Gauss-Newton relinearisation loop as a single ``lax.while_loop``.
 
@@ -393,6 +452,18 @@ def iterated_solve(
     count (n_valid * p): padding pixels contribute zero step, so dividing by
     the padded size would loosen the tolerance by n_pad/n_valid relative to
     the reference's ``len(x_analysis)`` (``linear_kf.py:296``).
+
+    ``inkernel_linearize`` — with ``use_pallas``, let operators that
+    advertise an analytic in-kernel linearisation
+    (``ObservationModel.inkernel_linearize``) run the WHOLE Gauss-Newton
+    loop inside the fused Pallas kernel (``pallas_solve.fused_gn_rows``)
+    — the linearisation, the iteration carry and the packed information
+    matrix all stay VMEM-resident; parity with the out-of-kernel path is
+    pinned within the documented 2e-3 float32 GN tolerance.  True by
+    default (it only engages when structurally possible — global-norm
+    mode, per-parameter bounds, empty operator params, static iteration
+    bounds); pass False to force the out-of-kernel linearise path, e.g.
+    to benchmark the two generations against each other.
 
     ``per_pixel_convergence`` — freeze each pixel once TWO consecutive
     steps satisfy ``||dx_i||_2 / p < tol`` (instead of the reference's
@@ -457,11 +528,13 @@ def iterated_solve(
         and n_bands <= 32
     ):
         # Fused-kernel fast path (global-norm mode): the whole per-date
-        # loop in row layout around one VMEM-resident Pallas kernel.
+        # loop in row layout around one VMEM-resident Pallas kernel —
+        # or, for operators advertising inkernel_linearize, INSIDE it.
         x, a, fwd, innovations, n_done, norm = _iterated_solve_rows(
             linearize, obs, x_forecast, p_inv_forecast, operator_params,
             tol, min_iterations, max_iterations, relaxation,
             state_bounds, norm_denominator, linearize_block,
+            inkernel_linearize=inkernel_linearize,
         )
         return _finish_solve(
             x, a, fwd, innovations, n_done, norm, None, obs,
@@ -746,11 +819,15 @@ def _blocked_linearize(linearize, operator_params, x, block: int):
     )
     n_bands = h0s.shape[1]
     h0 = jnp.moveaxis(h0s, 0, 1).reshape(n_bands, n_blocks * block)
+    # kafkalint: disable=kernel-relayout — block-axis merge of the
+    # lax.map outputs, not a (B, n, p) -> (B*p, n) lane relayout: the
+    # Jacobian keeps its dense layout here and reaches the kernel (if at
+    # all) through the jac_to_rows shim.
     jac = jnp.moveaxis(jacs, 0, 1).reshape(n_bands, n_blocks * block, p)
     return Linearization(h0=h0[:, :n_pix], jac=jac[:, :n_pix])
 
 
-@functools.partial(jax.jit, static_argnums=(0, 6, 7, 8, 9))
+@functools.partial(jax.jit, static_argnums=(0, 6, 7, 8, 9, 10, 11, 12))
 def _assimilate_date_impl(
     linearize: LinearizeFn,
     obs: BandBatch,
@@ -762,13 +839,21 @@ def _assimilate_date_impl(
     linearize_block: Any,
     use_pallas: bool,
     per_pixel_convergence: bool,
+    inkernel_linearize: bool,
+    min_iterations: Any,
+    max_iterations: Any,
 ):
     opts = dict(solver_options or {})
+    if min_iterations is not None:
+        opts["min_iterations"] = min_iterations
+    if max_iterations is not None:
+        opts["max_iterations"] = max_iterations
     return iterated_solve(
         linearize, obs, x_forecast, p_inv_forecast, operator_params,
         hessian_forward=hessian_forward, linearize_block=linearize_block,
         use_pallas=use_pallas,
-        per_pixel_convergence=per_pixel_convergence, **opts
+        per_pixel_convergence=per_pixel_convergence,
+        inkernel_linearize=inkernel_linearize, **opts
     )
 
 
@@ -791,18 +876,25 @@ def assimilate_date_jit(
 
     Numeric solver options (tol, relaxation, bounds...) flow through as
     traced values; structural options (``linearize_block`` — changes the
-    compiled program's shape — and ``use_pallas`` — swaps the solve
-    kernel) are split out as static arguments here.
+    compiled program's shape — ``use_pallas`` / ``inkernel_linearize`` —
+    swap the solve kernel — and the iteration bounds, which become the
+    in-kernel loop's static trip count) are split out as static
+    arguments here.
     """
     opts = dict(solver_options or {})
     block = opts.pop("linearize_block", None)
     use_pallas = bool(opts.pop("use_pallas", False))
+    inkernel = bool(opts.pop("inkernel_linearize", True))
     per_pixel = bool(opts.pop("per_pixel_convergence", False))
+    min_it = opts.pop("min_iterations", None)
+    max_it = opts.pop("max_iterations", None)
     return _assimilate_date_impl(
         linearize, obs, x_forecast, p_inv_forecast, operator_params,
         opts or None, hessian_forward,
         None if block is None else int(block),
-        use_pallas, per_pixel,
+        use_pallas, per_pixel, inkernel,
+        None if min_it is None else int(min_it),
+        None if max_it is None else int(max_it),
     )
 
 
@@ -817,7 +909,7 @@ class ScanWindowStats(NamedTuple):
     nodata_count: jnp.ndarray    # (K,) int32
 
 
-@functools.partial(jax.jit, static_argnums=(0, 9, 11, 12, 13, 14))
+@functools.partial(jax.jit, static_argnums=(0, 9, 11, 12, 13, 14, 15, 16, 17))
 def _assimilate_scan_impl(
     linearize: LinearizeFn,
     obs_stacked: BandBatch,
@@ -834,11 +926,18 @@ def _assimilate_scan_impl(
     linearize_block: Any,
     per_pixel_convergence: bool,
     use_pallas: bool,
+    inkernel_linearize: bool,
+    min_iterations: Any,
+    max_iterations: Any,
 ):
     from .linalg import batched_diagonal, spd_inverse_batched
     from .propagators import advance as advance_fn
 
     opts = dict(solver_options or {})
+    if min_iterations is not None:
+        opts["min_iterations"] = min_iterations
+    if max_iterations is not None:
+        opts["max_iterations"] = max_iterations
 
     def step(carry, inp):
         x_a, p_inv_a = carry
@@ -855,7 +954,8 @@ def _assimilate_scan_impl(
             hessian_forward=hessian_forward,
             linearize_block=linearize_block,
             use_pallas=use_pallas,
-            per_pixel_convergence=per_pixel_convergence, **opts
+            per_pixel_convergence=per_pixel_convergence,
+            inkernel_linearize=inkernel_linearize, **opts
         )
         out = (
             x_n, batched_diagonal(p_inv_n),
@@ -921,10 +1021,16 @@ def assimilate_windows_scan(
     block = opts.pop("linearize_block", None)
     # Structural (static) options split out exactly as in
     # assimilate_date_jit: ``use_pallas`` swaps each scan step's solve for
-    # the fused VMEM-resident kernel — the scan carries it as a static
-    # argument, so the fused and XLA programs are distinct jit entries.
+    # the fused VMEM-resident kernel (``inkernel_linearize`` additionally
+    # moves the whole GN loop inside it for capable operators, and the
+    # iteration bounds become the in-kernel static trip count) — the scan
+    # carries them as static arguments, so the fused and XLA programs are
+    # distinct jit entries.
     use_pallas = bool(opts.pop("use_pallas", False))
+    inkernel = bool(opts.pop("inkernel_linearize", True))
     per_pixel = bool(opts.pop("per_pixel_convergence", False))
+    min_it = opts.pop("min_iterations", None)
+    max_it = opts.pop("max_iterations", None)
     if m_matrix is None:
         m_matrix = jnp.eye(x_analysis0.shape[-1], dtype=jnp.float32)
     if q_diag is None:
@@ -934,4 +1040,7 @@ def assimilate_windows_scan(
         m_matrix, q_diag, prior_mean, prior_inv, state_propagator,
         opts or None, hessian_forward,
         None if block is None else int(block), per_pixel, use_pallas,
+        inkernel,
+        None if min_it is None else int(min_it),
+        None if max_it is None else int(max_it),
     )

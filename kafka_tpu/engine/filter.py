@@ -301,7 +301,9 @@ class KalmanFilter:
             # Bound solver peak memory on big batches: linearise in
             # sequential 256k-pixel blocks (the batched value+Jacobian is
             # ~11 KB/px of live intermediates for deep operators — without
-            # blocking, ~1.4M px exhausts a 16 GB chip).
+            # blocking, ~1.4M px exhausts a 16 GB chip).  Harmless when
+            # the in-kernel-linearise path engages: that path is
+            # O(kernel block) memory by construction and ignores this.
             if self.gather.n_pad > 262144:
                 opts.setdefault("linearize_block", 262144)
             if self.band_sequential:
@@ -629,7 +631,12 @@ class KalmanFilter:
         """Engine-level fusability: a date-invariant (or absent) prior.
         ``use_pallas`` composes with fusion — the scan threads it through
         as a static argument, so each step's solve runs the fused
-        VMEM-resident kernel (parity-tested in tests/test_fusion.py)."""
+        VMEM-resident kernel (parity-tested in tests/test_fusion.py);
+        operators advertising ``inkernel_linearize`` additionally run
+        each step's whole Gauss-Newton loop INSIDE that kernel (the
+        solver discovers the capability from the bound ``linearize``
+        itself — nothing extra threads through the engine beyond the
+        ``inkernel_linearize`` solver-option opt-out)."""
         if self.scan_window <= 1 or self.band_sequential:
             return False
         return self.prior is None or bool(

@@ -56,8 +56,31 @@ class ObservationModel:
     #: Optional (lower, upper) per-parameter physical domain; the solver
     #: projects every Gauss-Newton iterate into it (core.solvers).
     state_bounds = None
+    #: Operators that implement ``kernel_linearize_rows`` set this True:
+    #: the fused Pallas solve (``use_pallas``) then inlines the analytic
+    #: value+Jacobian and runs the WHOLE Gauss-Newton loop VMEM-resident
+    #: (``core.pallas_solve.fused_gn_rows``) — no ``(B, n, p)`` Jacobian
+    #: tensor, no relayout, no while_loop carry crossing HBM.  Everything
+    #: else (GP banks, PROSAIL, plain closures) keeps the out-of-kernel
+    #: ``linearize`` path behind the same ``LinearizeFn`` protocol.
+    inkernel_linearize: bool = False
 
     def forward_pixel(self, aux: Any, x_pixel: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def kernel_linearize_rows(self, x_rows):
+        """Lane-row analytic value+Jacobian for the fused Pallas kernel.
+
+        ``x_rows`` is a tuple of ``p`` state lane vectors (one per
+        parameter, any common shape); returns ``(h0, jac)`` with ``h0`` a
+        list of ``n_bands`` lane vectors and ``jac[b][k]`` the
+        ``dH0[b]/dx[k]`` lane vector — already in the kernel's row
+        layout.  Must be built from elementwise jnp ops only (it lowers
+        inside a Pallas TPU kernel; no vmap, no gather, no reshape) and
+        must match ``linearize`` to float32 reassociation tolerance —
+        the parity tests pin both.  Only consulted when
+        ``inkernel_linearize`` is True.
+        """
         raise NotImplementedError
 
     def aux_in_axes(self, aux: Any, n_pix: int):

@@ -36,6 +36,7 @@ two-point boundary problem solved in closed form below.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -117,3 +118,45 @@ class TwoStreamOperator(ObservationModel):
             sub = x_pixel[self._mappers[b]]
             out.append(self.forward_band_pixel(aux, b, sub))
         return jnp.stack(out)
+
+    # ---- in-kernel linearisation (core.pallas_solve.fused_gn_rows) ----
+
+    #: the two-stream forward is closed-form elementwise jnp — its
+    #: value+Jacobian lowers inside a Pallas TPU kernel, so the whole
+    #: Gauss-Newton loop can run VMEM-resident (no Jacobian relayout, no
+    #: while_loop carry, no separate linearize program).
+    inkernel_linearize = True
+
+    def kernel_linearize_rows(self, x_rows):
+        """Lane-row analytic value+Jacobian: tuple of p state lane
+        vectors -> (h0 list (B), jac list-of-lists with jac[b][k] =
+        dH0[b]/dx[k]) — ``jac_rows`` born directly in the fused kernel's
+        row layout, never as a ``(B, n, p)`` tensor.
+
+        Derivatives come from ``jax.jvp`` of the SAME
+        ``twostream_albedo`` closed form the batched ``linearize`` path
+        differentiates (one implementation of the physics to maintain);
+        each band touches only its 4 mapped parameters, so 4 one-hot
+        tangents per band cover the full Jacobian row block.
+        """
+
+        def band(omega, d, tlai, soil):
+            return twostream_albedo(omega, d, soil, tlai_to_lai(tlai))
+
+        zero = jnp.zeros_like(x_rows[0])
+        h0_out, jac_out = [], []
+        for b in range(self.n_bands):
+            mapper = [int(i) for i in self._mappers[b]]
+            sub = tuple(x_rows[i] for i in mapper)
+            rows = [zero] * len(x_rows)
+            val = None
+            for k in range(len(sub)):
+                tangents = tuple(
+                    jnp.ones_like(s) if j == k else jnp.zeros_like(s)
+                    for j, s in enumerate(sub)
+                )
+                val, dot = jax.jvp(band, sub, tangents)
+                rows[mapper[k]] = dot
+            h0_out.append(val)
+            jac_out.append(rows)
+        return h0_out, jac_out

@@ -1,0 +1,28 @@
+"""kernel-relayout seeds: dense (B, n, p) Jacobian relayouts in core/
+outside the sanctioned ``jac_to_rows`` compat shim."""
+
+import jax.numpy as jnp
+
+
+def leak_jacobian_rows(lin, n_bands, p, n):
+    jac_rows = jnp.moveaxis(lin.jac, 2, 1).reshape(n_bands * p, n)  # expect: kernel-relayout
+    swapped = jnp.transpose(lin.jac, (1, 0, 2))  # expect: kernel-relayout
+    return jac_rows, swapped
+
+
+def leak_method_form(jac, n_bands, p, n):
+    flat = jac.reshape(n_bands * p, n)  # expect: kernel-relayout
+    rolled = jac.swapaxes(0, 1)  # expect: kernel-relayout
+    return flat, rolled
+
+
+def jac_to_rows(jac):
+    """A local shim definition is sanctioned — its body never flags."""
+    return jnp.moveaxis(jac, 2, 1).reshape(-1, jac.shape[1])
+
+
+def relayout_of_other_arrays_is_fine(x, state):
+    # Non-Jacobian relayouts are the kernel's normal layout work.
+    cols = jnp.transpose(x)
+    stacked = state.reshape(-1, 4)
+    return cols, stacked

@@ -75,6 +75,35 @@ class TestCompareRows:
         assert any("device_pallas_ms" in ln and "skipped" in ln
                    for ln in lines)
 
+    def test_disappeared_row_is_a_gating_failure(self):
+        """A device_*_ms row the old artifact carried that is missing
+        (or null) in the new one is a dropped measurement — the kernel
+        path silently stopped being measured — and gates like a
+        regression instead of passing as 'not shared'."""
+        bc = _load()
+        gone = artifact()
+        del gone["device_pallas_ms"]
+        regressions, lines = bc.compare_rows(artifact(), gone)
+        assert len(regressions) == 1
+        assert "device_pallas_ms" in regressions[0]
+        assert "disappeared" in regressions[0]
+        # Nulled (off-TPU re-measure) gates identically to deleted.
+        regressions_null, _ = bc.compare_rows(
+            artifact(), artifact(pallas_ms=None)
+        )
+        assert len(regressions_null) == 1
+
+    def test_appearing_row_still_skipped(self):
+        """Coverage GROWING (a new row in the new artifact, e.g.
+        device_pallas_fused_lin_ms landing) must never fail the gate."""
+        bc = _load()
+        grown = artifact()
+        grown["device_pallas_fused_lin_ms"] = 2.1
+        regressions, lines = bc.compare_rows(artifact(), grown)
+        assert regressions == []
+        assert any("device_pallas_fused_lin_ms" in ln and "skipped" in ln
+                   for ln in lines)
+
     def test_improvement_not_flagged(self):
         bc = _load()
         regressions, lines = bc.compare_rows(
@@ -114,6 +143,27 @@ class TestMain:
         new = write(tmp_path, "new.json", artifact(xla_ms=6.4 * 1.07))
         assert bc.main([old, new]) == 0
         assert bc.main([old, new, "--threshold", "0.05"]) == 1
+
+    def test_exit_nonzero_on_disappeared_row(self, tmp_path, capsys):
+        bc = _load()
+        old = write(tmp_path, "old.json", artifact())
+        gone = artifact()
+        del gone["device_pallas_ms"]
+        new = write(tmp_path, "new.json", gone)
+        assert bc.main([old, new]) == 1
+        assert "disappeared" in capsys.readouterr().err
+
+    def test_disappeared_row_unjudgeable_when_unhealthy(self, tmp_path,
+                                                        capsys):
+        """The unhealthy downgrade applies to disappearance too: an
+        off-band window that skipped a measurement is weather."""
+        bc = _load()
+        old = write(tmp_path, "old.json", artifact())
+        gone = artifact(unhealthy=True)
+        del gone["device_pallas_ms"]
+        new = write(tmp_path, "new.json", gone)
+        assert bc.main([old, new]) == 0
+        assert "UNJUDGEABLE" in capsys.readouterr().err
 
     def test_missing_file_is_usage_error(self, tmp_path):
         bc = _load()

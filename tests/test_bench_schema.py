@@ -16,12 +16,14 @@ import bench
 EXPECTED_KEYS = [
     "metric", "value", "unit",
     "vs_baseline", "vs_baseline_at_scale",
-    "oracle_ms_median", "oracle_ms_spread",
+    "oracle_ms_median", "oracle_ms_spread", "oracle_ms_min",
     "n_pix_device", "n_pix_matched",
     "device_px_s_matched", "device_ms_matched_median",
     "device_ms_matched_spread",
     "device_xla_ms", "device_xla_ms_spread",
     "device_pallas_ms", "device_pallas_ms_spread", "device_pallas_px_s",
+    "device_pallas_fused_lin_ms", "device_pallas_fused_lin_ms_spread",
+    "device_pallas_fused_lin_px_s",
     "e2e_pixel_steps_per_s", "e2e_device_fraction", "e2e_n_pixels",
     "probe_device_ms", "probe_host_ms", "probe_retried",
     "unhealthy_reasons", "probe_host_after_ms", "unhealthy",
@@ -38,10 +40,11 @@ def _assemble(reg, host_after_ms=0.3):
     health = bench.probe_health(retry_wait_s=0.0, registry=reg)
     return health, bench.assemble_result(
         health,
-        oracle=(1.0e5, 160.0, 12.0),
+        oracle=(1.0e5, 160.0, 12.0, 154.0),
         device_matched=(2.0e6, 8.0, 0.5),
         device=(8.2e7, 6.4, 0.05),
-        pallas=None,           # off-TPU: the Pallas row is never measured
+        pallas=None,           # off-TPU: the Pallas rows are never measured
+        fused_lin=None,
         e2e=(5.0e4, 0.55, 7212),
         host_after_ms=host_after_ms,
         registry=reg,
@@ -67,6 +70,9 @@ class TestBenchArtifactSchema:
         assert result["device_pallas_ms"] is None
         assert result["device_pallas_ms_spread"] is None
         assert result["device_pallas_px_s"] is None
+        assert result["device_pallas_fused_lin_ms"] is None
+        assert result["device_pallas_fused_lin_ms_spread"] is None
+        assert result["device_pallas_fused_lin_px_s"] is None
         assert result["probe_device_ms"] is None
 
     def test_telemetry_snapshot_carries_health_gauges(self):
@@ -95,7 +101,7 @@ class TestBenchArtifactSchema:
             health = bench.probe_health(retry_wait_s=0.0, registry=reg)
             result = bench.assemble_result(
                 health,
-                oracle=(1.0e5, 160.0, 12.0),
+                oracle=(1.0e5, 160.0, 12.0, 154.0),
                 device_matched=(2.0e6, 8.0, 0.5),
                 device=(8.2e7, 6.4, 0.05),
                 pallas=None,
@@ -112,3 +118,26 @@ class TestBenchArtifactSchema:
         assert result["vs_baseline"] == pytest.approx(20.0)
         assert result["vs_baseline_at_scale"] == pytest.approx(820.0)
         assert result["e2e_n_pixels"] == 7212
+        assert result["oracle_ms_min"] == 154.0
+
+    def test_fused_lin_row_flows_through_on_tpu_artifacts(self):
+        """When the TPU bench measures the in-kernel generation, its
+        triple lands as the device_pallas_fused_lin_* rows (the
+        acceptance row: fused_lin < pallas on a healthy artifact)."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            health = bench.probe_health(retry_wait_s=0.0, registry=reg)
+            result = bench.assemble_result(
+                health,
+                oracle=(1.0e5, 160.0, 12.0, 154.0),
+                device_matched=(2.0e6, 8.0, 0.5),
+                device=(8.2e7, 6.4, 0.05),
+                pallas=(1.4e8, 3.8, 0.04),
+                fused_lin=(2.6e8, 2.0, 0.03),
+                e2e=(5.0e4, 0.55, 7212),
+                host_after_ms=0.3,
+                registry=reg,
+            )
+        assert result["device_pallas_fused_lin_ms"] == 2.0
+        assert result["device_pallas_fused_lin_px_s"] == 2.6e8
+        assert result["device_pallas_fused_lin_ms"] < \
+            result["device_pallas_ms"]

@@ -143,6 +143,36 @@ class TestFusedParity:
         np.testing.assert_allclose(x_p, x_u, atol=2e-3)
         np.testing.assert_allclose(x_p, x_x, atol=2e-3)
 
+    @pytest.mark.slow
+    def test_fused_scan_inkernel_linearize_end_to_end(self):
+        """The in-kernel Gauss-Newton path (operator-advertised analytic
+        linearisation, whole GN loop inside the Pallas kernel) through
+        the FULL production pipeline with temporal fusion: the
+        ``assimilate_windows_scan`` program with ``inkernel_linearize``
+        (the default for capable operators) must engage fusion and match
+        both the out-of-kernel Pallas run and the XLA run."""
+        kf_ik, out_ik, x_ik, _, mask = run_pipeline(
+            scan_window=4, solver_options={"use_pallas": True}
+        )
+        assert any("fused" in r for r in kf_ik.diagnostics_log), \
+            "in-kernel linearise must not veto temporal fusion"
+        kf_pl, out_pl, x_pl, _, _ = run_pipeline(
+            scan_window=4, mask=mask,
+            solver_options={"use_pallas": True,
+                            "inkernel_linearize": False},
+        )
+        kf_x, out_x, x_x, _, _ = run_pipeline(scan_window=4, mask=mask)
+        # GN tolerance-ball reasoning as above: anything beyond ~tol is
+        # a real semantic bug (dropped capability, wrong carry...).
+        np.testing.assert_allclose(x_ik, x_pl, atol=2e-3)
+        np.testing.assert_allclose(x_ik, x_x, atol=2e-3)
+        # User-facing rasters agree window by window.
+        for ts in out_x.output:
+            np.testing.assert_allclose(
+                out_ik.output[ts]["TeLAI"], out_x.output[ts]["TeLAI"],
+                atol=2e-3, err_msg=str(ts),
+            )
+
     def test_multidate_window_breaks_block_not_correctness(self):
         # grid_step=3 puts 3 acquisitions in each window -> no fusion
         # (len(locate_times) != 1), result identical to the unfused run.

@@ -620,6 +620,216 @@ class TestPallasSolve:
             )
 
 
+class _QuadRowsOperator:
+    """Minimal ``inkernel_linearize`` operator for configurable (p,
+    n_bands): y_b = sum_k c[b,k] x_k^2 with the analytic lane-row
+    Jacobian 2 c[b,k] x_k.  Implements exactly the
+    ``ObservationModel`` surface the solver touches."""
+
+    inkernel_linearize = True
+    aux_per_pixel = True
+
+    def __init__(self, coeff):
+        self.coeff = np.asarray(coeff, np.float32)
+        self.n_bands, self.n_params = self.coeff.shape
+        self.state_bounds = (
+            np.full(self.n_params, -10.0, np.float32),
+            np.full(self.n_params, 10.0, np.float32),
+        )
+
+    def linearize(self, aux, x):
+        c = jnp.asarray(self.coeff)
+        return Linearization(
+            h0=jnp.einsum("bp,np->bn", c, x**2),
+            jac=2.0 * c[:, None, :] * x[None, :, :],
+        )
+
+    def kernel_linearize_rows(self, x_rows):
+        p = self.n_params
+        h0 = [
+            sum(float(c[k]) * x_rows[k] ** 2 for k in range(p))
+            for c in self.coeff
+        ]
+        jac = [
+            [2.0 * float(c[k]) * x_rows[k] for k in range(p)]
+            for c in self.coeff
+        ]
+        return h0, jac
+
+
+class TestInKernelLinearize:
+    """The in-kernel Gauss-Newton path (operator-advertised analytic
+    linearisation, whole loop as ONE Pallas launch) against the XLA
+    reference — the tentpole parity suite (p in {3, 7}, 1/2 bands)."""
+
+    def _quad_problem(self, p, n_bands, n_pix=256, seed=0):
+        rng = np.random.default_rng(seed)
+        coeff = rng.uniform(0.5, 1.5, size=(n_bands, p)).astype(np.float32)
+        op = _QuadRowsOperator(coeff)
+        x_f = np.full((n_pix, p), 0.8, np.float32)
+        x_true = x_f + rng.normal(0, 0.05, (n_pix, p)).astype(np.float32)
+        y = np.einsum("bp,np->bn", coeff, x_true**2).astype(np.float32)
+        mask = rng.uniform(size=y.shape) > 0.2
+        r_inv = np.where(mask, 25.0, 0.0).astype(np.float32)
+        # NaN nodata under the mask, exactly as io/warp.py produces it.
+        bands = BandBatch(
+            y=jnp.asarray(np.where(mask, y, np.nan).astype(np.float32)),
+            r_inv=jnp.asarray(r_inv),
+            mask=jnp.asarray(mask),
+        )
+        p_inv = np.broadcast_to(
+            4.0 * np.eye(p, dtype=np.float32), (n_pix, p, p)
+        ).copy()
+        return op, bands, jnp.asarray(x_f), jnp.asarray(p_inv)
+
+    def _parity(self, op, bands, x0, p_inv0, aux=None):
+        from kafka_tpu.core.solvers import assimilate_date_jit
+
+        opts = {"state_bounds": (
+            jnp.asarray(op.state_bounds[0]),
+            jnp.asarray(op.state_bounds[1]),
+        )}
+        x_ref, a_ref, d_ref = assimilate_date_jit(
+            op.linearize, bands, x0, p_inv0, aux, opts
+        )
+        x_ik, a_ik, d_ik = assimilate_date_jit(
+            op.linearize, bands, x0, p_inv0, aux,
+            {**opts, "use_pallas": True, "inkernel_linearize": True},
+        )
+        x_ik_np, a_ik_np = np.asarray(x_ik), np.asarray(a_ik)
+        assert np.isfinite(x_ik_np).all(), "NaN leaked into the state"
+        assert np.isfinite(a_ik_np).all(), "NaN leaked into A"
+        # The documented float32 GN-feedback tolerance (2e-3, BASELINE.md
+        # "Roofline" numerics): the in-kernel accumulation order differs
+        # from XLA's fusion schedule and the loop feeds it back.
+        np.testing.assert_allclose(x_ik_np, np.asarray(x_ref), atol=2e-3)
+        np.testing.assert_allclose(
+            a_ik_np, np.asarray(a_ref), rtol=2e-2, atol=2e-2
+        )
+        assert int(d_ik.n_iterations) == int(d_ref.n_iterations)
+        for field in ("innovations", "fwd_modelled"):
+            got = np.asarray(getattr(d_ik, field))
+            assert np.isfinite(got).all(), f"NaN leaked into {field}"
+            np.testing.assert_allclose(
+                got, np.asarray(getattr(d_ref, field)), atol=5e-3,
+                err_msg=field,
+            )
+
+    @pytest.mark.parametrize("p,n_bands", [(3, 1), (3, 2), (7, 1)])
+    def test_parity_quad_operator(self, p, n_bands):
+        self._parity(*self._quad_problem(p, n_bands, seed=p * 10 + n_bands))
+
+    def test_parity_twostream_p7_two_band(self):
+        """The production TIP configuration (p=7, 2 bands) through the
+        REAL operator's analytic in-kernel linearisation."""
+        from kafka_tpu.testing.synthetic import make_tip_problem
+
+        op, bands, x0, p_inv0 = make_tip_problem(512, mask_prob=0.2)
+        self._parity(op, bands, x0, p_inv0)
+
+    def test_twostream_kernel_rows_born_in_lane_layout(self):
+        """Zero-relayout contract, asserted at the source: the
+        operator's ``kernel_linearize_rows`` emits ``h0``/``jac`` rows
+        DIRECTLY as lane vectors matching the batched ``linearize``
+        transposed — there is no (B, n, p) tensor to relayout."""
+        from kafka_tpu.obsops.twostream import TwoStreamOperator
+
+        op = TwoStreamOperator()
+        rng = np.random.default_rng(3)
+        n, p = 64, op.n_params
+        lo, hi = op.state_bounds
+        x = (lo + (hi - lo) * rng.uniform(0.1, 0.9, (n, p))).astype(
+            np.float32
+        )
+        x_rows = tuple(jnp.asarray(x[:, k]) for k in range(p))
+        h0_rows, jac_rows = op.kernel_linearize_rows(x_rows)
+        lin = op.linearize(None, jnp.asarray(x))
+        for b in range(op.n_bands):
+            assert h0_rows[b].shape == (n,), "h0 not a lane row"
+            np.testing.assert_allclose(
+                np.asarray(h0_rows[b]), np.asarray(lin.h0[b]), atol=1e-5
+            )
+            for k in range(p):
+                assert jac_rows[b][k].shape == (n,), "jac not a lane row"
+                np.testing.assert_allclose(
+                    np.asarray(jac_rows[b][k]),
+                    np.asarray(lin.jac[b, :, k]),
+                    atol=1e-5, err_msg=f"band {b} dparam {k}",
+                )
+
+    def test_inkernel_jaxpr_has_no_jacobian_relayout(self):
+        """The fused-kernel zero-relayout assertion at the program
+        level: the in-kernel solve's jaxpr contains NO transpose of a
+        rank-3 array (the (B, n, p) Jacobian and its (B*p, n) relayout
+        never exist), while the out-of-kernel Pallas path — the positive
+        control — contains at least one."""
+        import jax
+
+        from kafka_tpu.core.solvers import iterated_solve
+        from kafka_tpu.testing.synthetic import make_tip_problem
+
+        op, bands, x0, p_inv0 = make_tip_problem(256)
+
+        def transposes_3d(closed):
+            count = 0
+
+            def walk(jaxpr):
+                nonlocal count
+                for eqn in jaxpr.eqns:
+                    if eqn.primitive.name == "transpose" and \
+                            eqn.invars[0].aval.ndim >= 3:
+                        count += 1
+                    for v in eqn.params.values():
+                        vs = v if isinstance(v, (list, tuple)) else [v]
+                        for item in vs:
+                            inner = getattr(item, "jaxpr", None)
+                            if inner is not None:
+                                walk(inner)
+                            elif hasattr(item, "eqns"):
+                                walk(item)
+
+            walk(closed.jaxpr)
+            return count
+
+        def make(inkernel):
+            return jax.make_jaxpr(
+                lambda b, x, pi: iterated_solve(
+                    op.linearize, b, x, pi, None, use_pallas=True,
+                    inkernel_linearize=inkernel,
+                )
+            )(bands, x0, p_inv0)
+
+        assert transposes_3d(make(True)) == 0
+        # Positive control: the out-of-kernel path relays the Jacobian
+        # through the jac_to_rows shim — a 3-D transpose — every
+        # iteration, so the counter cannot silently rot.
+        assert transposes_3d(make(False)) > 0
+
+    def test_nonempty_operator_params_fall_back(self):
+        """Per-date aux keeps the out-of-kernel path (the in-kernel
+        operators are closed-form); results stay correct either way."""
+        from kafka_tpu.core.solvers import assimilate_date_jit
+        from kafka_tpu.testing.synthetic import make_tip_problem
+
+        op, bands, x0, p_inv0 = make_tip_problem(256)
+        opts = {"state_bounds": (
+            jnp.asarray(op.state_bounds[0]),
+            jnp.asarray(op.state_bounds[1]),
+        )}
+        aux = {"dummy": jnp.zeros((3,), jnp.float32)}
+        x_ref, _, d_ref = assimilate_date_jit(
+            op.linearize, bands, x0, p_inv0, aux, opts
+        )
+        x_pl, _, d_pl = assimilate_date_jit(
+            op.linearize, bands, x0, p_inv0, aux,
+            {**opts, "use_pallas": True},
+        )
+        np.testing.assert_allclose(
+            np.asarray(x_pl), np.asarray(x_ref), atol=2e-3
+        )
+        assert int(d_pl.n_iterations) == int(d_ref.n_iterations)
+
+
 class TestPerPixelConvergence:
     """solver option per_pixel_convergence (SURVEY §7(c)): converged
     pixels freeze at their fixed point instead of riding a global norm."""

@@ -6,7 +6,10 @@ write-only.  This tool makes it actionable:
 
 - compares every ``device_*_ms`` timing row shared by the two artifacts
   and **exits non-zero when any regresses by more than the threshold**
-  (default 10%, new > old * 1.10) — the CI gate for perf PRs;
+  (default 10%, new > old * 1.10) — the CI gate for perf PRs — or when
+  a row the old artifact carried **disappears** from the new one (a
+  dropped measurement is a silent path breakage, not a skip; rows
+  appearing in the new artifact stay informational);
 - refuses to issue a REGRESSION verdict off artifacts flagged
   ``unhealthy`` (rounds 3-5 proved those archive environment weather, not
   code): off-band artifacts downgrade the verdict to UNJUDGEABLE
@@ -47,12 +50,29 @@ def device_rows(artifact: dict) -> Dict[str, float]:
 
 def compare_rows(old: dict, new: dict, threshold: float = 0.10,
                  ) -> Tuple[List[str], List[str]]:
-    """(regressions, report_lines) over the shared device timing rows."""
+    """(regressions, report_lines) over the device timing rows.
+
+    A row present (non-null) in the old artifact but missing or null in
+    the new one is a GATING FAILURE, not a skip: a dropped
+    ``device_*_ms`` row means the measurement silently stopped happening
+    (the kernel path broke, the TPU gate mis-fired, a rename), which is
+    exactly the regression class "compare only shared rows" cannot see.
+    Rows that APPEAR in the new artifact remain informational — growing
+    coverage must not fail the gate.
+    """
     rows_old, rows_new = device_rows(old), device_rows(new)
     regressions: List[str] = []
     lines: List[str] = []
     for key in sorted(set(rows_old) | set(rows_new)):
         a, b = rows_old.get(key), rows_new.get(key)
+        if a is not None and b is None:
+            regressions.append(
+                f"{key}: {a:.3f} ms -> MISSING (row disappeared from the "
+                "new artifact — a dropped measurement gates like a "
+                "regression)"
+            )
+            lines.append(f"  {key}: {a:.3f} -> MISSING  REGRESSION")
+            continue
         if a is None or b is None:
             lines.append(f"  {key}: only in {'new' if a is None else 'old'} "
                          "artifact — skipped")

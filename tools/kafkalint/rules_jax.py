@@ -275,6 +275,85 @@ class StaticArgFlag(Rule):
         return findings
 
 
+#: the one function allowed to relayout a dense Jacobian batch — the
+#: compat shim for operators without an in-kernel linearisation
+#: (core/pallas_solve.py).
+RELAYOUT_SHIM = "jac_to_rows"
+
+_RELAYOUT_FUNCS = {"transpose", "moveaxis", "swapaxes", "reshape"}
+
+
+@register
+class KernelRelayout(Rule):
+    name = "kernel-relayout"
+    description = (
+        "jnp.transpose/moveaxis/reshape (or the method forms) applied to "
+        "a (B, n, p) Jacobian array in core/ outside the sanctioned "
+        "jac_to_rows compat shim — every such relayout is an extra HBM "
+        "pass the fused kernel exists to delete; operators should "
+        "advertise inkernel_linearize (jac_rows born in lane layout) or "
+        "route through the shim"
+    )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None or not ctx.rel.startswith("kafka_tpu/core/"):
+            return ()
+        jnp_names = jitscan.jnp_aliases(ctx.tree)
+        findings: List[Finding] = []
+        seen_lines = set()
+
+        def mentions_jac(node: ast.AST) -> bool:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name) and "jac" in sub.id.lower():
+                    return True
+                if isinstance(sub, ast.Attribute) and \
+                        "jac" in sub.attr.lower():
+                    return True
+            return False
+
+        def flag(node: ast.Call, what: str) -> None:
+            if node.lineno in seen_lines:
+                return  # one finding per relayout chain/line
+            seen_lines.add(node.lineno)
+            findings.append(Finding(
+                path=ctx.rel, line=node.lineno, rule=self.name,
+                message=(
+                    f"{what} on a Jacobian array outside the sanctioned "
+                    f"{RELAYOUT_SHIM} shim — a dense (B, n, p) relayout "
+                    "is an extra HBM pass; use the shim (out-of-kernel "
+                    "operators) or kernel_linearize_rows (in-kernel "
+                    "lane-layout Jacobians)"
+                ),
+            ))
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == RELAYOUT_SHIM:
+                # the shim itself: its body is the one sanctioned use.
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        seen_lines.add(sub.lineno)
+        np_names = jitscan.numpy_aliases(ctx.tree)
+        module_aliases = jnp_names | np_names
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr in _RELAYOUT_FUNCS):
+                continue
+            if isinstance(f.value, ast.Name) and \
+                    f.value.id in module_aliases:
+                # module form: jnp.moveaxis(jac, ...) — the jac mention
+                # lives in the arguments.
+                if mentions_jac(node):
+                    flag(node, f"{f.value.id}.{f.attr}()")
+            elif mentions_jac(f.value):
+                # method form: lin.jac.reshape(...) / jac_rows.transpose()
+                flag(node, f".{f.attr}() method")
+        return findings
+
+
 def _flag_kind(param: ast.arg, default) -> str:
     """'bool'/'str' when the parameter is annotated or defaulted as such."""
     ann = param.annotation

@@ -245,12 +245,13 @@ def main():
     else:
         from bench import bench_oracle
 
-        px_s, ms_median, ms_spread = bench_oracle(args.oracle_n)
+        px_s, ms_median, ms_spread, ms_min = bench_oracle(args.oracle_n)
         row = {
             "row": "oracle", "n_pixels": args.oracle_n,
             "px_per_s": round(px_s, 1),
             "ms_median": round(ms_median, 1),
             "ms_spread": round(ms_spread, 1),
+            "ms_min": round(ms_min, 1),
         }
     print(json.dumps(row))
 

@@ -26,7 +26,13 @@ Components, at n = 2^19 pixels (the benchmark operating size):
 - ``update``: packed normal-equations assembly + packed Cholesky +
   substitutions, given a linearisation (``core.solvers.kalman_update``),
 - ``gn_full``: the production Gauss-Newton ``lax.while_loop``
-  (``assimilate_date_jit``, 2 iterations on this problem).
+  (``assimilate_date_jit``, 2 iterations on this problem),
+- ``gn_full_pallas`` / ``gn_inkernel`` (TPU only): the same loop on the
+  two fused-kernel generations — whole-update kernel with out-of-kernel
+  linearisation, and the whole GN loop (analytic in-kernel
+  linearisation, VMEM-resident carry) as one launch; ``gn_inkernel``
+  carries its own re-derived traffic bound (packed-triangle prior and
+  information matrix, diagnostics counted).
 
 Usage:  python tools/roofline.py [--n 524288] [--json out.json]
 
@@ -207,21 +213,59 @@ def tip_components(n_pix, rows):
     )
     row["n_iterations"] = n_iters
 
-    # -- the same full GN loop on the fused Pallas path (use_pallas):
-    # the BASELINE.md "Roofline" pair.  Real-chip only — the CPU
+    # -- the same full GN loop on the fused Pallas paths (use_pallas):
+    # the BASELINE.md "Roofline" rows.  Real-chip only — the CPU
     # interpreter times the Pallas interpreter, not the kernel.
+    # inkernel_linearize is pinned False here so this row keeps
+    # measuring the PR 1 kernel generation (out-of-kernel linearise).
     if jax.default_backend() == "tpu":
         row_pl = measure(
             "tip/gn_full_pallas",
-            _full_jit(op, {**opts, "use_pallas": True}),
+            _full_jit(op, {**opts, "use_pallas": True,
+                           "inkernel_linearize": False}),
             (bands, x0, p_inv0),
             lambda o: np.asarray(o[0][:1, 0]), rows, min_full,
             note=f"{n_iters} GN iterations, fused VMEM-resident kernel",
         )
         row_pl["n_iterations"] = n_iters
+        # -- the in-kernel-linearise generation: the WHOLE loop as one
+        # launch.  Re-derived analytic bound: with linearisation,
+        # iteration carry and packed A all VMEM-resident, the only HBM
+        # traffic left is the observations in, the forecast in (the
+        # packed prior triangle — the dense (p, p) batch never needs to
+        # cross for the kernel proper), and the solution + diagnostics
+        # out.  Unlike min_full above this bound COUNTS the diagnostic
+        # outputs (fwd, innovations, per-block counters) the solve
+        # emits — gn_full's bound conservatively omitted them.
+        tri = p * (p + 1) // 2
+        min_inkernel = n_pix * f32 * (
+            3 * n_bands        # y, r_inv, mask in
+            + p                # x_f lane rows in
+            + tri              # P_f^-1 packed rows in
+            + p + tri          # x out + packed A out
+            + 2 * n_bands      # fwd + innovation diagnostics out
+            + 2                # per-block iteration/norm rows out
+        )
+        row_ik = measure(
+            "tip/gn_inkernel",
+            _full_jit(op, {**opts, "use_pallas": True,
+                           "inkernel_linearize": True}),
+            (bands, x0, p_inv0),
+            lambda o: np.asarray(o[0][:1, 0]), rows, min_inkernel,
+            note=(
+                f"whole GN loop ({n_iters} iters) + analytic "
+                "linearisation in ONE kernel launch"
+            ),
+        )
+        row_ik["n_iterations"] = n_iters
     else:
         print(
             "tip/gn_full_pallas       skipped - no TPU (interpret-mode "
+            "timings measure the interpreter, not the kernel)",
+            file=sys.stderr,
+        )
+        print(
+            "tip/gn_inkernel          skipped - no TPU (interpret-mode "
             "timings measure the interpreter, not the kernel)",
             file=sys.stderr,
         )
