@@ -17,11 +17,20 @@ def add_telemetry_arg(ap) -> None:
 
 def make_console(main_fn):
     """Wrap a driver ``main`` (which returns a result object for
-    programmatic callers) into a console-script entry point whose return
-    value ``sys.exit`` treats as success."""
+    programmatic callers) into a console-script entry point.
+
+    Exit codes: 0 on full success; ``EXIT_PARTIAL_SUCCESS`` (75,
+    sysexits EX_TEMPFAIL) when the run COMPLETED but quarantined chunks
+    — the result dict carries a nonzero ``"failed"`` — so a scheduler/CI
+    can distinguish "rerun the quarantined pieces" from a hard failure
+    (which still raises and exits nonzero the usual way)."""
 
     def console():
-        main_fn()
+        result = main_fn()
+        if isinstance(result, dict) and result.get("failed"):
+            from ..resilience import EXIT_PARTIAL_SUCCESS
+
+            return EXIT_PARTIAL_SUCCESS
         return 0
 
     return console

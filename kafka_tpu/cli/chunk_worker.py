@@ -44,6 +44,12 @@ def main(argv=None) -> int:
 
     enable_compilation_cache()
     install_compile_listeners()
+    # Chaos specs propagate into chunk workers through the environment,
+    # so a KAFKA_TPU_FAULTS run exercises the subprocess path too (call
+    # counters are per-process — spec call numbers are worker-local).
+    from ..resilience import faults
+
+    faults.install_from_env()
     cfg = RunConfig.load(cfg_path)
     # Per-chunk telemetry subdirectory: this fresh process must not
     # interleave its events/trace with the parent scheduler's files.

@@ -432,6 +432,14 @@ def run_one_chunk_resilient(
         # same chunk, or mosaics double-read those pixels.
         _remove_outputs(cfg, [f"*_{prefix}-[abcd]*.tif"])
         return summary
+    if rc == 124:
+        # The worker was killed by the hang guard: transient-class by
+        # construction (TimeoutError), so a scheduler-level RetryPolicy
+        # re-attempts it — the kill already freed the wedged process.
+        raise TimeoutError(
+            f"chunk worker for {prefix} exceeded its wall-clock "
+            "timeout and was killed"
+        )
     if rc != OOM_EXIT_CODE:
         raise RuntimeError(
             f"chunk worker for {prefix} failed (rc={rc})"
@@ -485,6 +493,7 @@ def run_config(
     reference driver, including the dask fan-out (serial loop and
     distributed execution are the same code path here;
     ``kafka_test_S2.py:196-205`` vs ``kafka_test_Py36.py:242-255``)."""
+    from ..resilience import RetryPolicy, faults
     from ..telemetry import (
         configure, flight_recorder, get_registry,
         install_compile_listeners, tracing,
@@ -495,6 +504,9 @@ def run_config(
     install_compile_listeners()
     if cfg.telemetry_dir:
         configure(cfg.telemetry_dir)
+    # Chaos-run hook: KAFKA_TPU_FAULTS scripts deterministic failures at
+    # the registered fault points (BASELINE.md "Fault tolerance").
+    faults.install_from_env()
     # Crash forensics: unhandled exceptions, SIGTERM/SIGINT and unhealthy
     # probe verdicts dump crash_<ts>.json into the telemetry directory
     # (no-op without one — see telemetry.flight_recorder).
@@ -516,12 +528,30 @@ def run_config(
             summaries.append(s)
             LOG.info("chunk %s: %s", prefix, json.dumps(s))
 
+    # Fault-tolerance knobs ride RunConfig.extra["fault_tolerance"]:
+    # {"chunk_attempts": 3, "backoff_s": 2.0, "quarantine": true,
+    #  "chunk_deadline_s": 3600}.  Defaults keep fail-fast semantics.
+    ft = dict((getattr(cfg, "extra", None) or {})
+              .get("fault_tolerance") or {})
+    attempts = int(ft.get("chunk_attempts", 1))
+    retry_policy = RetryPolicy(
+        max_attempts=attempts,
+        base_delay=float(ft.get("backoff_s", 2.0)),
+        multiplier=float(ft.get("backoff_multiplier", 2.0)),
+        jitter=float(ft.get("jitter", 0.1)),
+    ) if attempts > 1 else None
+    deadline_s = ft.get("chunk_deadline_s")
     # One trace context for the whole run: chunk/window ids are pushed
     # below it, and the recorder guard dumps on the way out of a failure.
     with tracing.push(run_id=tracing.new_run_id()), recorder:
         stats = run_chunks(
             chunks, run_one, cfg.output_folder,
             num_processes=num_processes, process_index=process_index,
+            retry_policy=retry_policy,
+            quarantine=bool(ft.get("quarantine", False)),
+            chunk_deadline_s=(
+                float(deadline_s) if deadline_s is not None else None
+            ),
         )
     stats["chunks_with_pixels"] = len(summaries)
     stats["pixels"] = int(sum(s["n_pixels"] for s in summaries))

@@ -9,6 +9,14 @@ WCM operators standing in for the data-dependent emulator paths.
 Usage:
     python -m kafka_tpu.cli.run_synthetic --operator twostream \
         --outdir /tmp/kafka_out --days 16 --step 4
+
+``--chunk-size N`` routes the run through the restart-safe chunk
+scheduler (``shard.run_chunks``) with quarantine enabled — one
+KalmanFilter per NxN chunk, prefixed outputs, per-chunk retry — which
+makes this driver the fault-tolerance chaos harness: script failures
+with ``KAFKA_TPU_FAULTS`` (see ``kafka_tpu.resilience.faults``) and the
+run completes with exit code 75 (partial success) when chunks were
+quarantined, while unaffected chunks produce bit-identical outputs.
 """
 
 from __future__ import annotations
@@ -18,6 +26,7 @@ import datetime
 import json
 import logging
 import os
+import sys
 import time
 
 import numpy as np
@@ -105,6 +114,24 @@ def main(argv=None):
     ap.add_argument("--obs-every", type=int, default=2,
                     help="observation cadence in days")
     ap.add_argument("--checkpoint", action="store_true")
+    ap.add_argument("--chunk-size", type=int, default=0,
+                    help="run as NxN chunks through the restart-safe "
+                         "scheduler with quarantine on (0 = one run)")
+    ap.add_argument("--chunk-attempts", type=int, default=2,
+                    help="attempts per chunk under the scheduler retry "
+                         "policy (chunked mode)")
+    ap.add_argument("--chunk-deadline-s", type=float, default=None,
+                    help="per-chunk wall-clock deadline; over-budget "
+                         "chunks are quarantined (chunked mode)")
+    ap.add_argument("--read-attempts", type=int, default=3,
+                    help="attempts per observation read before the date "
+                         "degrades to predict-only")
+    ap.add_argument("--retry-delay-s", type=float, default=0.25,
+                    help="base backoff delay for read/chunk retries "
+                         "(deterministic, jitter-free schedule)")
+    ap.add_argument("--max-degraded-dates", type=int, default=8,
+                    help="degraded-date budget per filter run before "
+                         "aborting")
     add_telemetry_arg(ap)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
@@ -121,6 +148,15 @@ def main(argv=None):
     if args.telemetry_dir:
         configure(args.telemetry_dir)
     recorder = flight_recorder.install(args.telemetry_dir)
+    from ..resilience import RetryPolicy, faults
+
+    # Chaos hook: KAFKA_TPU_FAULTS scripts deterministic failures at the
+    # registered fault points (BASELINE.md "Fault tolerance").
+    faults.install_from_env()
+    read_policy = RetryPolicy(
+        max_attempts=max(1, args.read_attempts),
+        base_delay=args.retry_delay_s, multiplier=2.0, jitter=0.0,
+    )
     if args.mask:
         mask_arr, info = read_geotiff(args.mask)
         mask = mask_arr.astype(bool)
@@ -146,6 +182,57 @@ def main(argv=None):
     truth = np.broadcast_to(
         truth_val, mask.shape + (len(truth_val),)
     ).astype(np.float32)
+
+    t0 = time.time()
+    # One trace context for the run; the recorder guard turns a mid-run
+    # death into a crash_<ts>.json next to the other telemetry artifacts.
+    with tracing.push(run_id=tracing.new_run_id()), recorder:
+        if args.chunk_size > 0:
+            summary = _run_chunked(
+                args, mask, geo, op, params, prior, truth, aux_fn,
+                sigma, obs_dates, time_grid, read_policy,
+            )
+        else:
+            summary = _run_single(
+                args, mask, geo, op, params, prior, truth, aux_fn,
+                sigma, obs_dates, time_grid, read_policy,
+            )
+    wall = time.time() - t0
+
+    summary["operator"] = args.operator
+    summary["n_dates"] = len(obs_dates)
+    summary["n_timesteps"] = len(time_grid) - 1
+    summary["wall_s"] = round(wall, 3)
+    summary["pixel_steps_per_s"] = round(
+        summary["n_pixels"] * len(obs_dates) / wall, 1
+    )
+    summary["outputs_written"] = len(
+        [f for f in os.listdir(args.outdir) if f.endswith(".tif")]
+    )
+    summary["outdir"] = args.outdir
+    reg = get_registry()
+    reg.emit("run_done", **{k: v for k, v in summary.items()})
+    summary["telemetry_dir"] = reg.dump()
+    print(json.dumps(summary))
+    return summary
+
+
+def _make_filter(args, sub_mask, output, op, params, obs, read_policy):
+    kf = KalmanFilter(
+        obs, output, sub_mask, params,
+        state_propagation=propagate_information_filter,
+        prior=None,
+        solver_options={"relaxation": 0.5},
+        read_retry_policy=read_policy,
+        max_degraded_dates=args.max_degraded_dates,
+    )
+    kf.set_trajectory_model()
+    kf.set_trajectory_uncertainty(np.full(len(params), 1e-3, np.float32))
+    return kf
+
+
+def _run_single(args, mask, geo, op, params, prior, truth, aux_fn,
+                sigma, obs_dates, time_grid, read_policy) -> dict:
     observations = SyntheticObservations(
         dates=obs_dates, operator=op,
         truth_fn=lambda date: truth, sigma=sigma, aux_fn=aux_fn,
@@ -155,54 +242,93 @@ def main(argv=None):
         params, geo.geotransform, geo.projection, args.outdir,
         epsg=geo.epsg, async_writes=True,
     )
-    kf = KalmanFilter(
-        observations, output, mask, params,
-        state_propagation=propagate_information_filter,
-        prior=None,
-        solver_options={"relaxation": 0.5},
-    )
-    kf.set_trajectory_model()
-    kf.set_trajectory_uncertainty(np.full(len(params), 1e-3, np.float32))
+    kf = _make_filter(args, mask, output, op, params, observations,
+                      read_policy)
     x0, p_inv0 = prior.process_prior(None, kf.gather)
-
     ck = Checkpointer(os.path.join(args.outdir, "ckpt")) \
         if args.checkpoint else None
-    t0 = time.time()
-    # One trace context for the run; the recorder guard turns a mid-run
-    # death into a crash_<ts>.json next to the other telemetry artifacts.
-    with tracing.push(run_id=tracing.new_run_id()), recorder:
-        kf.run(time_grid, x0, None, p_inv0, checkpointer=ck)
+    kf.run(time_grid, x0, None, p_inv0, checkpointer=ck)
     output.close()
-    wall = time.time() - t0
-
-    n_outputs = len([f for f in os.listdir(args.outdir)
-                     if f.endswith(".tif")])
-    n_steps = len(time_grid) - 1
-    summary = {
-        "operator": args.operator,
+    return {
         "n_pixels": int(kf.gather.n_valid),
-        "n_dates": len(obs_dates),
-        "n_timesteps": n_steps,
-        "wall_s": round(wall, 3),
-        "pixel_steps_per_s": round(
-            kf.gather.n_valid * len(obs_dates) / wall, 1
-        ),
-        "outputs_written": n_outputs,
-        "outdir": args.outdir,
         "mean_iterations": round(
             float(np.mean([d["n_iterations"]
                            for d in kf.diagnostics_log] or [0])), 2
         ),
     }
-    reg = get_registry()
-    reg.emit("run_done", **{k: v for k, v in summary.items()})
-    summary["telemetry_dir"] = reg.dump()
-    print(json.dumps(summary))
-    return summary
+
+
+def _run_chunked(args, mask, geo, op, params, prior, truth, aux_fn,
+                 sigma, obs_dates, time_grid, read_policy) -> dict:
+    """The chunk-scheduler path: one filter per NxN chunk with prefixed
+    outputs, per-chunk retry and quarantine — the synthetic chaos
+    harness for the fault-tolerance layer (exit code 75 when chunks end
+    up quarantined; see module docstring)."""
+    from ..io.tiling import chunk_geotransform, chunk_mask, get_chunks
+    from ..resilience import RetryPolicy
+    from ..shard.scheduler import run_chunks
+
+    ny, nx = mask.shape
+    chunks = list(get_chunks(nx, ny, (args.chunk_size, args.chunk_size)))
+    summaries = []
+
+    def run_one(chunk, prefix):
+        sub_mask = chunk_mask(mask, chunk)
+        if not sub_mask.any():
+            return
+        sub_truth = np.ascontiguousarray(
+            truth[chunk.y0:chunk.y0 + chunk.ny_valid,
+                  chunk.x0:chunk.x0 + chunk.nx_valid]
+        )
+        obs = SyntheticObservations(
+            dates=obs_dates, operator=op,
+            truth_fn=lambda date: sub_truth, sigma=sigma, aux_fn=aux_fn,
+            mask_prob=0.1,
+        )
+        output = GeoTIFFOutput(
+            params, chunk_geotransform(geo.geotransform, chunk),
+            geo.projection, args.outdir, prefix=prefix, epsg=geo.epsg,
+            async_writes=True,
+        )
+        kf = _make_filter(args, sub_mask, output, op, params, obs,
+                          read_policy)
+        x0, p_inv0 = prior.process_prior(None, kf.gather)
+        ck = Checkpointer(
+            os.path.join(args.outdir, "ckpt"), prefix=f"{prefix}_"
+        ) if args.checkpoint else None
+        try:
+            kf.run(time_grid, x0, None, p_inv0, checkpointer=ck)
+        except BaseException:
+            # A failed attempt must not leak the async writer thread
+            # into the retry (same teardown contract as the drivers).
+            output.close()
+            raise
+        output.close()
+        summaries.append({
+            "prefix": prefix, "n_pixels": int(kf.gather.n_valid),
+        })
+
+    policy = RetryPolicy(
+        max_attempts=max(1, args.chunk_attempts),
+        base_delay=args.retry_delay_s, multiplier=2.0, jitter=0.0,
+    ) if args.chunk_attempts > 1 else None
+    stats = run_chunks(
+        chunks, run_one, args.outdir, num_processes=1, process_index=0,
+        retry_policy=policy, quarantine=True,
+        chunk_deadline_s=args.chunk_deadline_s,
+    )
+    return {
+        "mode": "chunked",
+        "chunks_assigned": stats["assigned"],
+        "chunks_run": stats["run"],
+        "skipped": stats["skipped"],
+        "failed": stats["failed"],
+        "n_pixels": int(sum(s["n_pixels"] for s in summaries)),
+    }
 
 
 console = make_console(main)
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(console())

@@ -20,14 +20,27 @@ individually-rereadable pieces instead of one monolithic array.
 from __future__ import annotations
 
 import datetime
+import logging
 import os
 import re
+import zipfile
 from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..resilience import faults
+from ..telemetry import get_registry
+
+LOG = logging.getLogger(__name__)
+
 _FMT = "%Y%m%dT%H%M%S"
 _RX = re.compile(r"state_(\d{8}T\d{6})(?:\.shard(\d+)of(\d+))?\.npz$")
+
+#: what a truncated / empty / corrupted .npz raises out of ``np.load``
+#: (zip CRC and header errors, short reads, missing keys).
+_UNREADABLE_ERRORS = (
+    OSError, EOFError, ValueError, KeyError, zipfile.BadZipFile,
+)
 
 
 def pack_tril(a: np.ndarray) -> np.ndarray:
@@ -87,12 +100,23 @@ class Checkpointer:
         for shard in range(self.n_shards):
             lo, hi = bounds[shard], bounds[shard + 1]
             path = self._path(timestep, shard)
-            np.savez_compressed(
-                path,
-                x_analysis=x[lo:hi],
-                p_inv_tril=tril[lo:hi],
-                p=np.int64(p),
-            )
+            faults.fault_point("checkpoint.save", path=path)
+            # Atomic write: a crash mid-save must never leave a
+            # truncated .npz under the FINAL name (load_latest would
+            # have treated it as the newest complete checkpoint).  The
+            # tmp is written through a file handle so np.savez doesn't
+            # append its own .npz suffix.
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                np.savez_compressed(
+                    f,
+                    x_analysis=x[lo:hi],
+                    p_inv_tril=tril[lo:hi],
+                    p=np.int64(p),
+                )
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
             paths.append(path)
         return paths
 
@@ -142,11 +166,35 @@ class Checkpointer:
         assembled full matrix would not fit host RAM (the shards partition
         the pixel axis in order, ``np.linspace`` bounds as written)."""
         ckpts = self.list_checkpoints()
-        if not ckpts:
-            return None
-        ts, paths = ckpts[-1]
-        if shard is not None:
-            paths = [paths[shard]]
+        # Newest first; an unreadable/truncated set (crash mid-save
+        # pre-dating the atomic writer, torn filesystem, bit rot) is
+        # skipped with a logged event and the previous intact set wins —
+        # resuming slightly earlier beats dying on a corrupt file.
+        for ts, paths in reversed(ckpts):
+            use = [paths[shard]] if shard is not None else paths
+            try:
+                x, p_inv = self._load_set(use)
+            except _UNREADABLE_ERRORS as exc:
+                LOG.warning(
+                    "checkpoint %s is unreadable (%r); falling back to "
+                    "the previous intact checkpoint", ts, exc,
+                )
+                get_registry().counter(
+                    "kafka_checkpoint_unreadable_total",
+                    "checkpoint sets skipped by load_latest because a "
+                    "file was truncated/corrupt",
+                ).inc()
+                get_registry().emit(
+                    "checkpoint_unreadable", timestep=str(ts),
+                    paths=[os.path.basename(q) for q in use],
+                    error=repr(exc)[:300],
+                )
+                continue
+            return ts, x, p_inv
+        return None
+
+    @staticmethod
+    def _load_set(paths: List[str]):
         xs, trils, p = [], [], 0
         for path in paths:
             data = np.load(path)
@@ -161,10 +209,10 @@ class Checkpointer:
                     trils.append(pack_tril(full))
         x = np.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
         if p == 0:
-            return ts, x, None
+            return x, None
         tril = (np.concatenate(trils, axis=0) if len(trils) > 1
                 else trils[0])
-        return ts, x, unpack_tril(tril.astype(np.float32), p)
+        return x, unpack_tril(tril.astype(np.float32), p)
 
     def resume_time_grid(self, time_grid):
         """Trim a time grid to the steps strictly after the last checkpoint.

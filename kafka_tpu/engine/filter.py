@@ -31,6 +31,14 @@ from ..core.types import BandBatch
 from .prefetch import ObservationPrefetcher
 from .protocols import DateObservation, ObservationSource, OutputWriter, Prior
 from .state import PixelGather, make_pixel_gather
+from ..resilience import (
+    DEFAULT_READ_POLICY,
+    TRANSIENT,
+    DegradedDateError,
+    RetryPolicy,
+    classify_failure,
+    faults,
+)
 from ..telemetry import (
     fetch_scalars,
     get_registry,
@@ -77,6 +85,8 @@ class KalmanFilter:
         mesh_lane: int = 128,
         checkpoint_every_n: int = 1,
         band_sequential: bool = False,
+        read_retry_policy: Optional[RetryPolicy] = None,
+        max_degraded_dates: int = 8,
     ):
         self.observations = observations
         self.output = output
@@ -124,6 +134,21 @@ class KalmanFilter:
         # Observations fetched while probing a fusion block but consumed
         # by the unfused path instead (prefetcher dates pop exactly once).
         self._pending_obs: dict = {}
+        # Graceful degradation (BASELINE.md "Fault tolerance"): a date
+        # whose read exhausts its transient-failure retries is consumed
+        # as a MISSING observation — the window becomes predict-only,
+        # which the Kalman structure handles natively — up to a budget
+        # of ``max_degraded_dates`` per run, after which the run aborts
+        # (losing more dates than that is a systemic outage, not
+        # weather).  Poison/fatal read errors stay fail-fast.
+        self._read_policy = read_retry_policy \
+            if read_retry_policy is not None else DEFAULT_READ_POLICY
+        self.max_degraded_dates = max_degraded_dates
+        self._degraded_count = 0
+        # Dates the fusion-probing path already consumed as degraded;
+        # the unfused window path reads the degradation from here
+        # (prefetcher dates pop exactly once).
+        self._degraded_pending: set = set()
         # The reference's LEGACY band-sequential path
         # (``linear_kf.py:325-425``): each band assimilates alone, its
         # posterior becoming the next band's prior, with its own
@@ -262,16 +287,62 @@ class KalmanFilter:
             state_propagator=self._state_propagator,
         )
 
-    def _fetch(self, date) -> DateObservation:
+    def _fetch(self, date) -> Optional[DateObservation]:
+        """The date's observation, or None when its read DEGRADED (the
+        caller must then treat the date as having no observation)."""
         if self._pending_obs:
             hit = self._pending_obs.pop(date, None)
             if hit is not None:
                 return hit
+        if date in self._degraded_pending:
+            self._degraded_pending.discard(date)
+            return None
         if self._prefetcher is not None:
-            return self._prefetcher.get(date)
-        return self._shard_obs(
-            self.observations.get_observations(date, self.gather)
+            try:
+                return self._prefetcher.get(date)
+            except DegradedDateError as exc:
+                self._note_degraded(date, exc.cause)
+                return None
+
+        def read():
+            faults.fault_point("prefetch.read_date", date=str(date))
+            return self.observations.get_observations(date, self.gather)
+
+        try:
+            obs = self._read_policy.call(read, site="prefetch.read_date")
+        except BaseException as exc:
+            if classify_failure(exc) != TRANSIENT:
+                raise
+            self._note_degraded(date, exc)
+            return None
+        return self._shard_obs(obs)
+
+    def _note_degraded(self, date, exc: BaseException) -> None:
+        """Record one degraded date (counter + event + budget check)."""
+        self._degraded_count += 1
+        reg = get_registry()
+        reg.counter(
+            "kafka_engine_dates_degraded_total",
+            "observation dates whose read exhausted transient-failure "
+            "retries and were assimilated as missing (predict-only)",
+        ).inc()
+        reg.emit(
+            "date_degraded", date=str(date), error=repr(exc)[:300],
+            degraded_total=self._degraded_count,
+            budget=self.max_degraded_dates,
         )
+        LOG.warning(
+            "observation read for %s degraded after retries (%r); "
+            "treating as a missing observation (%d of %s budget)",
+            date, exc, self._degraded_count, self.max_degraded_dates,
+        )
+        if self.max_degraded_dates is not None and \
+                self._degraded_count > self.max_degraded_dates:
+            raise RuntimeError(
+                f"{self._degraded_count} degraded observation dates "
+                f"exceed max_degraded_dates={self.max_degraded_dates}; "
+                "aborting (systemic read outage, not transient weather)"
+            ) from exc
 
     def assimilate_dates(self, dates, x_forecast, p_forecast,
                          p_forecast_inverse):
@@ -285,6 +356,12 @@ class KalmanFilter:
             p_inv_a = spd_inverse_batched(jnp.asarray(p_a, jnp.float32))
         for date in dates:
             obs = self._fetch(date)
+            if obs is None:
+                # Degraded date: no observation to assimilate — the
+                # forecast passes through unchanged (predict-only), the
+                # same arithmetic as a window with no acquisitions.
+                LOG.info("Skipping degraded date %s (predict-only)", date)
+                continue
             t0 = time.time()
             opts = dict(self.solver_options or {})
             if "state_bounds" not in opts and \
@@ -553,6 +630,7 @@ class KalmanFilter:
                         self._shard_obs if self.mesh is not None else None
                     ),
                     workers=self.prefetch_workers,
+                    retry_policy=self._read_policy,
                 )
         try:
             # push() keeps the driver's run context when one is active and
@@ -852,6 +930,8 @@ class KalmanFilter:
             x_forecast, p_forecast, p_forecast_inverse
         )
         self._pending_obs = {}
+        self._degraded_pending = set()
+        self._degraded_count = 0
         self._windows_since_ckpt = 0
         idx = 0
         while idx < len(windows):
@@ -876,6 +956,13 @@ class KalmanFilter:
                         if len(lt_j) != 1:
                             break
                         obs_j = self._fetch(lt_j[0])
+                        if obs_j is None:
+                            # Degraded date: it can't join a fused block
+                            # (the scan has no missing-date slot).  The
+                            # degradation is already recorded; park it so
+                            # the unfused window path sees None again.
+                            self._degraded_pending.add(lt_j[0])
+                            break
                         if (block and not self._stackable(block[0][1], obs_j)) \
                                 or not self._block_fits(obs_j, len(block) + 1):
                             self._pending_obs[lt_j[0]] = obs_j

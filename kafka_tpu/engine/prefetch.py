@@ -29,6 +29,14 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .protocols import DateObservation, ObservationSource
 from .state import PixelGather
+from ..resilience import (
+    DEFAULT_READ_POLICY,
+    TRANSIENT,
+    DegradedDateError,
+    RetryPolicy,
+    classify_failure,
+    faults,
+)
 from ..telemetry import get_registry, tracing
 
 LOG = logging.getLogger(__name__)
@@ -39,9 +47,17 @@ class ObservationPrefetcher:
 
     ``get(date)`` returns the prefetched ``DateObservation`` for the next
     date in sequence — callers must consume dates in the order given
-    (the filter's time loop does).  A worker exception re-raises in the
-    caller at the ``get`` for the failing date; later dates already in
-    flight may complete but nothing new is claimed after a failure.
+    (the filter's time loop does).
+
+    Failure semantics (BASELINE.md "Fault tolerance"): a read that fails
+    with a TRANSIENT-class error is retried on the worker thread under
+    ``retry_policy``; if retries are exhausted the date is delivered
+    *degraded* — ``get`` raises :class:`DegradedDateError` so the engine
+    can consume it as a missing observation — and the workers keep
+    claiming later dates.  A POISON/FATAL-class error keeps today's
+    fail-fast behaviour: it re-raises in the caller at the ``get`` for
+    the failing date, and nothing new is claimed after it (later dates
+    already in flight may complete).
 
     With ``workers > 1`` the source's ``get_observations`` is called
     CONCURRENTLY for different dates — sources must tolerate concurrent
@@ -57,9 +73,12 @@ class ObservationPrefetcher:
         depth: int = 2,
         transform=None,
         workers: int = 1,
+        retry_policy: Optional[RetryPolicy] = None,
     ):
         self._source = source
         self._gather = gather
+        self._policy = retry_policy if retry_policy is not None \
+            else DEFAULT_READ_POLICY
         # Optional post-read hook run ON THE WORKER thread (e.g. the
         # engine's mesh commit, ``KalmanFilter._shard_obs``) so the
         # device upload/reshard overlaps the previous date's solve too.
@@ -129,13 +148,27 @@ class ObservationPrefetcher:
                 self._next_claim += 1
             date = self._dates[idx]
             t0 = time.perf_counter()
-            try:
+
+            def read():
+                faults.fault_point("prefetch.read_date", date=str(date))
                 obs = self._source.get_observations(date, self._gather)
                 if self._transform is not None:
                     obs = self._transform(obs)
-                item = ("ok", obs)
-            except BaseException as exc:  # re-raised at the caller's get()
-                item = ("error", exc)
+                return obs
+
+            try:
+                item = (
+                    "ok",
+                    self._policy.call(read, site="prefetch.read_date"),
+                )
+            except BaseException as exc:  # classified + re-raised at get()
+                # Exhausted-transient reads degrade (the engine treats
+                # the date as a missing observation); poison/fatal stay
+                # fail-fast and abort the run at this date's get().
+                if classify_failure(exc) == TRANSIENT:
+                    item = ("degraded", exc)
+                else:
+                    item = ("error", exc)
             if item[0] == "ok":
                 t1 = time.perf_counter()
                 self._m_read.observe(t1 - t0)
@@ -164,6 +197,19 @@ class ObservationPrefetcher:
             idx = self._next_emit
             while idx not in self._results and not self._stopped.is_set():
                 self._cond.wait(timeout=0.5)
+                # Watchdog: if every worker thread has exited and the
+                # awaited index still has no result, no notify is ever
+                # coming — fail loudly instead of spinning on the 0.5s
+                # wait forever (a worker killed by a fatal error, or a
+                # bug that let one exit without posting, used to wedge
+                # the engine here).
+                if (idx not in self._results
+                        and not self._stopped.is_set()
+                        and not any(t.is_alive() for t in self._threads)):
+                    raise RuntimeError(
+                        "prefetch workers died without delivering "
+                        f"{date!s}"
+                    )
             if idx not in self._results:
                 raise RuntimeError("prefetcher closed while waiting")
             kind, payload = self._results.pop(idx)
@@ -183,6 +229,8 @@ class ObservationPrefetcher:
                 f"prefetch order violation: requested {date}, queued "
                 f"{self._dates[idx]}"
             )
+        if kind == "degraded":
+            raise DegradedDateError(date, payload)
         return payload
 
     def close(self) -> None:

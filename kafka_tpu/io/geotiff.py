@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from . import native_codec
+from ..resilience import faults
 
 # --- TIFF constants -------------------------------------------------------
 
@@ -443,6 +444,7 @@ def _lzw_decode(data: bytes) -> bytes:
 def read_geotiff(path: str) -> Tuple[np.ndarray, TiffInfo]:
     """Read a whole GeoTIFF.  Returns ``(array, info)`` with array shaped
     (height, width) single-band or (height, width, bands)."""
+    faults.fault_point("io.read_band", path=path)
     with open(path, "rb") as f:
         info, _, _ = _parse_info_f(f)
         arr = _read_window_f(f, info, 0, 0, info.height, info.width)
@@ -462,6 +464,7 @@ def read_geotiff_window(path: str, row0: int, col0: int, nrows: int,
     back zero-filled.  Pass a previously obtained ``info`` (``read_info``)
     to skip re-parsing the header/IFD on repeated windows of one file.
     Returns ``(array, info)`` with array shaped ``(nrows, ncols[, bands])``."""
+    faults.fault_point("io.read_band", path=path)
     with open(path, "rb") as f:
         if info is None:
             info, _, _ = _parse_info_f(f)

@@ -1,0 +1,24 @@
+"""Fault tolerance: retry/backoff policy, failure classification,
+deadlines, and deterministic fault injection (BASELINE.md "Fault
+tolerance").
+
+Mechanism lives in the fragile layers (``engine.prefetch``,
+``shard.scheduler``, ``engine.checkpoint``); POLICY lives here, so every
+retry loop in the tree shares one backoff/classification vocabulary and
+one telemetry surface — enforced statically by the kafkalint
+``ad-hoc-retry`` rule.
+"""
+
+from . import faults  # noqa: F401
+from .policy import (  # noqa: F401
+    DEFAULT_READ_POLICY,
+    EXIT_PARTIAL_SUCCESS,
+    FATAL,
+    POISON,
+    TRANSIENT,
+    Deadline,
+    DeadlineExceeded,
+    DegradedDateError,
+    RetryPolicy,
+    classify_failure,
+)

@@ -20,7 +20,9 @@ from .mesh import (
 from .scheduler import (
     ChunkAssignment,
     assign_chunks,
+    failed_marker_path,
     mark_done,
+    mark_failed,
     pending_chunks,
     run_chunks,
 )
@@ -37,7 +39,9 @@ __all__ = [
     "shard_state",
     "ChunkAssignment",
     "assign_chunks",
+    "failed_marker_path",
     "mark_done",
+    "mark_failed",
     "pending_chunks",
     "run_chunks",
     "make_sharded_forward",

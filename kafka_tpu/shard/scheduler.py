@@ -12,12 +12,23 @@ replacement:
   ``jax.process_index()`` (every process computes the same assignment, no
   coordinator, no message passing — the "zero collectives" structure of the
   problem extends to scheduling);
-- **restartability**: a per-chunk ``.done`` marker next to the outputs.
+- **restartability**: a per-chunk ``.done`` marker next to the outputs
+  (written atomically — tmp + ``os.replace`` — so a crash mid-write can
+  never leave an empty marker that suppresses a rerun).
   ``pending_chunks`` skips completed work, so a restarted job (or a
   replacement host) re-runs only what's missing — strictly better than the
   reference, which reruns every chunk the dead worker owned.  A chunk that
   dies mid-run leaves NO marker, so a replacement process re-runs exactly
-  the missing chunks (tested in tests/test_shard.py).
+  the missing chunks (tested in tests/test_shard.py);
+- **fault tolerance** (BASELINE.md "Fault tolerance"): ``run_chunks``
+  optionally retries each chunk under a ``RetryPolicy`` (transient-class
+  failures only), enforces a per-chunk wall-clock deadline, and — with
+  ``quarantine=True`` — converts an exhausted/poison chunk into a
+  ``.chunk_<prefix>.failed`` marker carrying the failure payload so the
+  run CONTINUES and ``pending_chunks`` skips it on restart.  The nonzero
+  ``failed`` count in the returned stats becomes the drivers'
+  partial-success exit code.  The default (no policy, no quarantine)
+  keeps the historical fail-fast behaviour.
 
 ``run_chunks`` records completion counters, per-chunk wall-time histograms
 and straggler flags into the telemetry registry — the scheduler-level
@@ -27,6 +38,7 @@ slice of the observability layer (BASELINE.md "Observability").
 from __future__ import annotations
 
 import json
+import logging
 import os
 import statistics
 import time
@@ -36,7 +48,16 @@ from typing import Callable, Iterable, List, Optional, Sequence
 import jax
 
 from ..io.tiling import Chunk
+from ..resilience import (
+    FATAL,
+    Deadline,
+    RetryPolicy,
+    classify_failure,
+    faults,
+)
 from ..telemetry import get_registry, tracing
+
+LOG = logging.getLogger(__name__)
 
 #: a completed chunk is flagged a straggler when its wall time exceeds
 #: this multiple of the median of the chunks completed before it (with at
@@ -69,19 +90,46 @@ def marker_path(outdir: str, prefix: str) -> str:
     return os.path.join(outdir, f".chunk_{prefix}.done")
 
 
+def failed_marker_path(outdir: str, prefix: str) -> str:
+    """Quarantine marker: this chunk exhausted its retries (or was
+    poison) and the run continued without it.  Delete the marker to make
+    a restart re-attempt the chunk."""
+    return os.path.join(outdir, f".chunk_{prefix}.failed")
+
+
+def _write_marker(path: str, payload: dict) -> None:
+    """Atomic marker write: a crash mid-write must never leave an empty
+    marker that suppresses a rerun (tmp + ``os.replace``, same pattern
+    as ``engine.checkpoint``)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
 def mark_done(outdir: str, prefix: str, payload: Optional[dict] = None) -> None:
-    with open(marker_path(outdir, prefix), "w") as f:
-        json.dump({"finished": time.time(), **(payload or {})}, f)
+    _write_marker(marker_path(outdir, prefix),
+                  {"finished": time.time(), **(payload or {})})
+
+
+def mark_failed(outdir: str, prefix: str,
+                payload: Optional[dict] = None) -> None:
+    _write_marker(failed_marker_path(outdir, prefix),
+                  {"failed": time.time(), **(payload or {})})
 
 
 def pending_chunks(assignments: Iterable[ChunkAssignment], outdir: str,
                    process_index: Optional[int] = None,
                    ) -> List[ChunkAssignment]:
-    """This process's still-to-run chunks (restart-safe)."""
+    """This process's still-to-run chunks (restart-safe; quarantined
+    chunks — ``.failed`` marker — are skipped too, so a restarted run
+    doesn't immediately re-wedge on a known-bad chunk)."""
     me = process_index if process_index is not None else jax.process_index()
     return [
         a for a in assignments
-        if a.owner == me and not os.path.exists(marker_path(outdir, a.prefix))
+        if a.owner == me
+        and not os.path.exists(marker_path(outdir, a.prefix))
+        and not os.path.exists(failed_marker_path(outdir, a.prefix))
     ]
 
 
@@ -91,18 +139,32 @@ def run_chunks(
     outdir: str,
     num_processes: Optional[int] = None,
     process_index: Optional[int] = None,
+    retry_policy: Optional[RetryPolicy] = None,
+    quarantine: bool = False,
+    chunk_deadline_s: Optional[float] = None,
 ) -> dict:
     """Execute ``run_one(chunk, prefix)`` for every pending chunk owned by
     this process.  The serial-loop / ``client.map`` duality of the reference
     (``kafka_test_S2.py:203-205`` vs ``kafka_test_Py36.py:254``) collapses
-    into this one function: single-process runs own every chunk."""
+    into this one function: single-process runs own every chunk.
+
+    Fault tolerance is opt-in and layered: ``retry_policy`` re-runs a
+    chunk whose failure classifies TRANSIENT (backoff between attempts);
+    ``chunk_deadline_s`` turns an over-budget attempt into a
+    ``DeadlineExceeded`` (poison — a hung in-process ``run_one`` cannot
+    be killed, so it is never retried; the subprocess chunk-worker path
+    kills on its own timeout and surfaces here as a transient
+    ``TimeoutError``); ``quarantine=True`` converts any non-FATAL failure
+    that survives retries into a ``.chunk_<prefix>.failed`` marker +
+    ``failed`` count instead of aborting the run.  Defaults preserve the
+    historical fail-fast semantics exactly."""
     os.makedirs(outdir, exist_ok=True)
     assignments = assign_chunks(chunks, num_processes)
     todo = pending_chunks(assignments, outdir, process_index)
     stats = {"assigned": len([a for a in assignments if a.owner ==
                               (process_index if process_index is not None
                                else jax.process_index())]),
-             "run": 0, "skipped": 0, "wall_s": 0.0}
+             "run": 0, "skipped": 0, "failed": 0, "wall_s": 0.0}
     stats["skipped"] = stats["assigned"] - len(todo)
     reg = get_registry()
     m_done = reg.counter(
@@ -122,15 +184,61 @@ def run_chunks(
         "completed chunks slower than STRAGGLER_FACTOR x the median of "
         "prior completions",
     )
+    m_failed = reg.counter(
+        "kafka_shard_chunks_failed_total",
+        "chunks quarantined after exhausting retries (.failed marker "
+        "written, run continued)",
+    )
     m_pending.set(len(todo))
     walls: List[float] = []
     t0 = time.time()
     for a in todo:
         t_chunk = time.perf_counter()
-        # chunk_id scopes every span/event recorded inside the chunk run
-        # (engine phases, writes, reads) to this chunk's forensics.
-        with tracing.push(chunk_id=a.prefix):
-            run_one(a.chunk, a.prefix)
+
+        def attempt(a=a):
+            deadline = Deadline(chunk_deadline_s) \
+                if chunk_deadline_s else None
+            faults.fault_point("scheduler.run_one", prefix=a.prefix)
+            # chunk_id scopes every span/event recorded inside the chunk
+            # run (engine phases, writes, reads) to this chunk's
+            # forensics.
+            with tracing.push(chunk_id=a.prefix):
+                run_one(a.chunk, a.prefix)
+            if deadline is not None:
+                # In-process there is no way to kill a hung run_one; the
+                # deadline is checked on completion and classifies
+                # poison, so the chunk quarantines instead of retrying
+                # into the same hang.
+                deadline.check(f"chunk {a.prefix}")
+
+        try:
+            if retry_policy is not None:
+                retry_policy.call(attempt, site="scheduler.run_one")
+            else:
+                attempt()
+        except BaseException as exc:
+            cls = classify_failure(exc)
+            if cls == FATAL or not quarantine:
+                raise
+            stats["failed"] += 1
+            mark_failed(outdir, a.prefix, {
+                "chunk": a.chunk.chunk_no,
+                "failure_class": cls,
+                "error": repr(exc)[:500],
+            })
+            m_failed.inc()
+            m_pending.set(len(todo) - stats["run"] - stats["failed"])
+            reg.emit(
+                "chunk_quarantined", prefix=a.prefix,
+                chunk=a.chunk.chunk_no, failure_class=cls,
+                error=repr(exc)[:300],
+            )
+            LOG.error(
+                "chunk %s quarantined (%s): %r — run continues; delete "
+                "%s to re-attempt it",
+                a.prefix, cls, exc, failed_marker_path(outdir, a.prefix),
+            )
+            continue
         t_end = time.perf_counter()
         wall = t_end - t_chunk
         # The chunk-level block lands on its own "scheduler" track, so
@@ -144,7 +252,7 @@ def run_chunks(
         stats["run"] += 1
         m_done.inc()
         m_wall.observe(wall)
-        m_pending.set(len(todo) - stats["run"])
+        m_pending.set(len(todo) - stats["run"] - stats["failed"])
         if len(walls) >= _STRAGGLER_MIN_SAMPLES:
             median = statistics.median(walls)
             if wall > STRAGGLER_FACTOR * median:

@@ -142,6 +142,9 @@ def probe_health(retry_wait_s: float = 15.0,
         # is real weather and the run is flagged, not silently trusted.
         print(f"bench health: {'; '.join(reasons)} — retrying in "
               f"{retry_wait_s:.0f}s", file=sys.stderr)
+        # kafkalint: disable=ad-hoc-retry — single bounded re-read of an
+        # environment probe (no failure to classify, no backoff series);
+        # a RetryPolicy would add machinery without changing behaviour.
         time.sleep(retry_wait_s)
         host_ms, device_ms, reasons = read()
         retried = True

@@ -31,7 +31,10 @@ class RecordingSource:
     def get_observations(self, date, gather):
         t0 = time.monotonic()
         if self.fail_on is not None and date == self.fail_on:
-            raise IOError(f"synthetic read failure for {date}")
+            # ValueError classifies POISON (deterministic failure), so
+            # these tests pin the fail-fast path; transient-class errors
+            # retry/degrade instead — covered in tests/test_resilience.py.
+            raise ValueError(f"synthetic read failure for {date}")
         if self.delay:
             time.sleep(self.delay)
         with self._lock:
@@ -85,13 +88,15 @@ class TestPrefetcher:
             pf.close()
 
     def test_worker_error_reraises_at_get(self):
+        """POISON-class read errors keep the fail-fast contract: the
+        original exception re-raises at the failing date's get()."""
         dates = [day(0), day(1), day(2)]
         src = RecordingSource(dates, fail_on=day(1))
         gather = make_pixel_gather(np.ones((2, 2), bool), pad_multiple=16)
         pf = ObservationPrefetcher(src, gather, dates, depth=2)
         try:
             pf.get(day(0))
-            with pytest.raises(IOError, match="synthetic read failure"):
+            with pytest.raises(ValueError, match="synthetic read failure"):
                 pf.get(day(1))
         finally:
             pf.close()
@@ -164,7 +169,7 @@ class TestMultiWorkerPrefetch:
         try:
             for d in dates[:3]:
                 pf.get(d)
-            with pytest.raises(IOError, match="synthetic read failure"):
+            with pytest.raises(ValueError, match="synthetic read failure"):
                 pf.get(day(3))
         finally:
             pf.close()

@@ -1,0 +1,667 @@
+"""Fault-tolerance layer (ISSUE 6): retry/backoff policy, failure
+classification, deterministic fault injection, and the graceful
+degradation paths wired through prefetch, scheduler and checkpoint.
+
+The four chaos acceptance tests:
+
+(a) a transient read failure recovers via retry with BIT-IDENTICAL
+    outputs vs the fault-free run;
+(b) a retry-exhausted transient date degrades to predict-only and the
+    run completes with the counter/event recorded;
+(c) a poison chunk is quarantined with a ``.failed`` marker and the
+    surviving chunks all complete;
+(d) a truncated newest checkpoint falls back to the previous intact one.
+
+Plus the end-to-end ``KAFKA_TPU_FAULTS``-scripted chaos run of
+``run_synthetic`` combining (a)+(b)+(c) with a partial-success exit.
+"""
+
+import datetime
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from kafka_tpu import telemetry
+from kafka_tpu.engine import Checkpointer, KalmanFilter
+from kafka_tpu.engine.prefetch import ObservationPrefetcher
+from kafka_tpu.engine.state import make_pixel_gather
+from kafka_tpu.io.tiling import get_chunks
+from kafka_tpu.resilience import (
+    EXIT_PARTIAL_SUCCESS,
+    FATAL,
+    POISON,
+    TRANSIENT,
+    Deadline,
+    DeadlineExceeded,
+    DegradedDateError,
+    RetryPolicy,
+    classify_failure,
+    faults,
+)
+from kafka_tpu.shard.scheduler import (
+    failed_marker_path,
+    marker_path,
+    pending_chunks,
+    assign_chunks,
+    run_chunks,
+)
+
+
+def day(i):
+    return datetime.datetime(2021, 3, 1) + datetime.timedelta(days=i)
+
+
+#: zero-wait deterministic policies for tests.
+FAST2 = RetryPolicy(max_attempts=2, base_delay=0.0, jitter=0.0)
+FAST3 = RetryPolicy(max_attempts=3, base_delay=0.0, jitter=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# policy unit tests
+# ---------------------------------------------------------------------------
+
+class TestClassification:
+    def test_heuristics(self):
+        assert classify_failure(IOError("x")) == TRANSIENT
+        assert classify_failure(TimeoutError()) == TRANSIENT
+        assert classify_failure(ConnectionResetError()) == TRANSIENT
+        assert classify_failure(ValueError("bad shape")) == POISON
+        assert classify_failure(RuntimeError("?")) == POISON
+        assert classify_failure(MemoryError()) == FATAL
+        assert classify_failure(KeyboardInterrupt()) == FATAL
+
+    def test_explicit_attribute_wins(self):
+        exc = RuntimeError("flaky endpoint")
+        exc.kafka_failure_class = TRANSIENT
+        assert classify_failure(exc) == TRANSIENT
+
+    def test_injected_fault_carries_class(self):
+        f = faults.InjectedFault("a.b", 3, POISON)
+        assert classify_failure(f) == POISON
+
+    def test_deadline_exceeded_is_poison(self):
+        assert classify_failure(DeadlineExceeded("late")) == POISON
+
+
+class TestRetryPolicy:
+    def test_deterministic_schedule(self):
+        p = RetryPolicy(max_attempts=4, base_delay=0.5, multiplier=2.0,
+                        max_delay=1.5, jitter=0.0)
+        assert p.schedule() == [0.5, 1.0, 1.5]
+
+    def test_retries_transient_then_succeeds(self):
+        slept, calls = [], []
+        p = RetryPolicy(max_attempts=3, base_delay=0.25, multiplier=2.0,
+                        jitter=0.0, sleep=slept.append)
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("weather")
+            return "ok"
+
+        with telemetry.use(telemetry.MetricsRegistry()) as reg:
+            assert p.call(fn, site="t.site") == "ok"
+            assert reg.value("kafka_resilience_retries_total",
+                             site="t.site") == 2
+            assert [e["event"] for e in reg.events] == ["retry", "retry"]
+        assert slept == [0.25, 0.5]
+
+    def test_poison_never_retried(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ValueError("deterministic")
+
+        with telemetry.use(telemetry.MetricsRegistry()):
+            with pytest.raises(ValueError):
+                FAST3.call(fn)
+        assert len(calls) == 1
+
+    def test_exhaustion_reraises_original(self):
+        with telemetry.use(telemetry.MetricsRegistry()) as reg:
+            with pytest.raises(OSError, match="persistent"):
+                FAST2.call(lambda: (_ for _ in ()).throw(
+                    OSError("persistent")), site="t.x")
+            assert [e["event"] for e in reg.events] == \
+                ["retry", "retry_exhausted"]
+
+    def test_deadline(self):
+        d = Deadline(30.0)
+        assert not d.expired and d.remaining() > 0
+        d = Deadline(0.0)
+        time.sleep(0.001)
+        with pytest.raises(DeadlineExceeded, match="deadline"):
+            d.check("probe")
+
+
+# ---------------------------------------------------------------------------
+# fault-injection registry
+# ---------------------------------------------------------------------------
+
+class TestFaults:
+    def test_nth_call_and_counting(self):
+        faults.script("a.b", "2")
+        faults.fault_point("a.b")
+        with pytest.raises(faults.InjectedFault, match="call #2"):
+            faults.fault_point("a.b")
+        faults.fault_point("a.b")  # only the 2nd call was scripted
+        assert faults.call_count("a.b") == 3
+
+    def test_ranges_and_classes(self):
+        faults.script("s", "2-3", POISON)
+        faults.fault_point("s")
+        for _ in range(2):
+            with pytest.raises(faults.InjectedFault) as ei:
+                faults.fault_point("s")
+            assert ei.value.kafka_failure_class == POISON
+        faults.fault_point("s")  # call 4: clear again
+
+    def test_open_ended_and_star(self):
+        faults.script("t", "3+")
+        faults.fault_point("t")
+        faults.fault_point("t")
+        for _ in range(5):
+            with pytest.raises(faults.InjectedFault):
+                faults.fault_point("t")
+
+    def test_env_spec_round_trip(self):
+        n = faults.install_from_env(
+            {"KAFKA_TPU_FAULTS":
+             "prefetch.read_date@2;scheduler.run_one@3:poison"}
+        )
+        assert n == 2
+        faults.fault_point("prefetch.read_date")
+        with pytest.raises(faults.InjectedFault):
+            faults.fault_point("prefetch.read_date")
+        assert faults.install_from_env({}) == 0
+
+    def test_bad_specs_rejected(self):
+        with pytest.raises(ValueError, match="expected"):
+            faults.parse_spec("no-at-sign")
+        with pytest.raises(ValueError, match="class"):
+            faults.parse_spec("a.b@1:nuclear")
+
+    def test_inactive_registry_is_free(self):
+        # Nothing armed: fault points neither raise nor count.
+        faults.fault_point("x")
+        assert faults.call_count("x") == 0
+
+    def test_fired_fault_lands_in_telemetry(self):
+        faults.script("y", "1")
+        with telemetry.use(telemetry.MetricsRegistry()) as reg:
+            with pytest.raises(faults.InjectedFault):
+                faults.fault_point("y", context="hello")
+            assert reg.value("kafka_resilience_faults_injected_total",
+                             site="y") == 1
+            assert reg.events[-1]["event"] == "fault_injected"
+
+
+# ---------------------------------------------------------------------------
+# prefetch: retry, degradation, watchdog
+# ---------------------------------------------------------------------------
+
+class CountingSource:
+    """The prefetch worker itself fires the ``prefetch.read_date``
+    fault point (one call per attempt) — the source stays clean."""
+
+    def __init__(self, dates):
+        self.dates = list(dates)
+
+    def get_observations(self, date, gather):
+        return ("obs", date)
+
+
+class TestPrefetchResilience:
+    def _pf(self, dates, **kw):
+        gather = make_pixel_gather(np.ones((2, 2), bool), pad_multiple=16)
+        return ObservationPrefetcher(
+            CountingSource(dates), gather, dates, depth=2, **kw
+        )
+
+    def test_transient_read_recovers_via_retry(self):
+        dates = [day(i) for i in range(4)]
+        faults.script("prefetch.read_date", "2")
+        pf = self._pf(dates, retry_policy=FAST2)
+        try:
+            for d in dates:
+                assert pf.get(d) == ("obs", d)
+        finally:
+            pf.close()
+
+    def test_exhausted_transient_degrades_and_continues(self):
+        dates = [day(i) for i in range(4)]
+        faults.script("prefetch.read_date", "2-3")  # date 1, both tries
+        pf = self._pf(dates, retry_policy=FAST2)
+        try:
+            assert pf.get(dates[0]) == ("obs", dates[0])
+            with pytest.raises(DegradedDateError) as ei:
+                pf.get(dates[1])
+            assert ei.value.date == dates[1]
+            # Later dates still arrive: degraded does not stop claims.
+            assert pf.get(dates[2]) == ("obs", dates[2])
+            assert pf.get(dates[3]) == ("obs", dates[3])
+        finally:
+            pf.close()
+
+    def test_poison_read_stays_fail_fast(self):
+        dates = [day(i) for i in range(3)]
+        faults.script("prefetch.read_date", "2", POISON)
+        pf = self._pf(dates, retry_policy=FAST3)
+        try:
+            pf.get(dates[0])
+            with pytest.raises(faults.InjectedFault):
+                pf.get(dates[1])
+        finally:
+            pf.close()
+
+    def test_dead_workers_watchdog_instead_of_wedge(self):
+        dates = [day(0)]
+        pf = self._pf(dates)
+        try:
+            pf.get(day(0))
+            for t in pf._threads:
+                t.join(timeout=5.0)
+            # All workers exited, nothing will ever deliver day(1):
+            # the old wait loop spun forever here.
+            with pytest.raises(RuntimeError, match="workers died"):
+                pf.get(day(1))
+        finally:
+            pf.close()
+
+
+# ---------------------------------------------------------------------------
+# engine: chaos (a) retry-recovery bit-identical, (b) degraded dates
+# ---------------------------------------------------------------------------
+
+def _engine_run(read_policy=None, max_degraded=8, exclude=(),
+                prefetch_depth=2):
+    import jax.numpy as jnp
+
+    from kafka_tpu.core.propagators import PixelPrior
+    from kafka_tpu.engine import FixedGaussianPrior
+    from kafka_tpu.obsops import IdentityOperator
+    from kafka_tpu.testing import MemoryOutput, SyntheticObservations
+
+    rng = np.random.default_rng(3)
+    mask = np.ones((6, 6), bool)
+    p = 2
+    op = IdentityOperator(n_params=p, obs_indices=(0, 1))
+    truth = rng.uniform(0.3, 0.7, mask.shape + (p,)).astype(np.float32)
+    obs = SyntheticObservations(
+        dates=[day(i) for i in range(1, 7) if i not in exclude],
+        operator=op,
+        truth_fn=lambda date: truth,
+        sigma=0.02,
+        seed=5,
+    )
+    out = MemoryOutput()
+    mean = np.full((p,), 0.5, np.float32)
+    cov = np.diag(np.full((p,), 0.25)).astype(np.float32)
+    prior = FixedGaussianPrior(
+        PixelPrior(
+            mean=jnp.asarray(mean), cov=jnp.asarray(cov),
+            inv_cov=jnp.asarray(np.linalg.inv(cov)),
+        ),
+        ("a", "b"),
+    )
+
+    class PlainSource:
+        """Thin wrapper: the engine/prefetcher fire the
+        ``prefetch.read_date`` fault point (one call per attempt)."""
+
+        dates = obs.dates
+
+        def get_observations(self, date, gather):
+            return obs.get_observations(date, gather)
+
+    kf = KalmanFilter(
+        PlainSource(), out, mask, ("a", "b"),
+        state_propagation=None, prior=prior, pad_multiple=16,
+        prefetch_depth=prefetch_depth,
+        read_retry_policy=read_policy or FAST2,
+        max_degraded_dates=max_degraded,
+    )
+    kf.set_trajectory_model()
+    kf.set_trajectory_uncertainty(np.zeros(p, np.float32))
+    x0, p_inv0 = prior.process_prior(None, kf.gather)
+    grid = [day(0), day(3), day(6)]
+    x_a, _, p_inv_a = kf.run(grid, x0, None, p_inv0)
+    return np.asarray(x_a), np.asarray(p_inv_a), kf
+
+
+class TestEngineDegradation:
+    def test_chaos_a_transient_retry_bit_identical(self):
+        """One transient failure on the 2nd read, recovered by retry:
+        results must equal the fault-free run EXACTLY."""
+        x_ref, pinv_ref, _ = _engine_run()
+        faults.script("prefetch.read_date", "2")
+        with telemetry.use(telemetry.MetricsRegistry()) as reg:
+            x, pinv, _ = _engine_run()
+            assert reg.value("kafka_resilience_retries_total",
+                             site="prefetch.read_date") == 1
+            assert reg.value("kafka_engine_dates_degraded_total") is None
+        np.testing.assert_array_equal(x_ref, x)
+        np.testing.assert_array_equal(pinv_ref, pinv)
+
+    def test_chaos_b_exhausted_date_degrades_to_predict_only(self):
+        """Retries exhausted on one date: the run completes, the date is
+        consumed as a missing observation (results identical to a run
+        that never had it), counter + event recorded."""
+        # calls: 1 -> day1; 2,3 -> day2 twice (attempts of FAST2).
+        faults.script("prefetch.read_date", "2-3")
+        with telemetry.use(telemetry.MetricsRegistry()) as reg:
+            x, pinv, kf = _engine_run()
+            assert reg.value("kafka_engine_dates_degraded_total") == 1
+            kinds = [e["event"] for e in reg.events]
+            assert "date_degraded" in kinds and "retry_exhausted" in kinds
+            degraded = [e for e in reg.events
+                        if e["event"] == "date_degraded"][0]
+            assert "2021-03-03" in degraded["date"]
+        # The degraded date is absent from the assimilation log (the
+        # fault-free run assimilates 5 dates, day 2..6)...
+        assert len(kf.diagnostics_log) == 4
+        assert day(2) not in [d["date"] for d in kf.diagnostics_log]
+        # ...and the arithmetic equals the run that never saw day 2.
+        x_ref, pinv_ref, _ = _engine_run(exclude=(2,))
+        np.testing.assert_array_equal(x_ref, x)
+        np.testing.assert_array_equal(pinv_ref, pinv)
+
+    def test_degraded_budget_aborts(self):
+        faults.script("prefetch.read_date", "*")
+        with telemetry.use(telemetry.MetricsRegistry()):
+            with pytest.raises(RuntimeError, match="max_degraded_dates"):
+                _engine_run(max_degraded=0)
+
+    def test_synchronous_path_degrades_too(self):
+        """prefetch_depth=0 (reference-style reads in the loop) shares
+        the retry/degradation semantics."""
+        faults.script("prefetch.read_date", "2-3")
+        with telemetry.use(telemetry.MetricsRegistry()) as reg:
+            x, pinv, _ = _engine_run(prefetch_depth=0)
+            assert reg.value("kafka_engine_dates_degraded_total") == 1
+        x_ref, pinv_ref, _ = _engine_run(exclude=(2,))
+        np.testing.assert_array_equal(x_ref, x)
+        np.testing.assert_array_equal(pinv_ref, pinv)
+
+
+# ---------------------------------------------------------------------------
+# scheduler: chaos (c) quarantine + retry + deadline
+# ---------------------------------------------------------------------------
+
+class TestSchedulerResilience:
+    def _chunks(self, n=4):
+        return list(get_chunks(256, 64 * n, (256, 64)))[:n]
+
+    def test_chaos_c_poison_chunk_quarantined(self, tmp_path):
+        """The poison chunk writes a .failed marker; every surviving
+        chunk completes; the failed count is returned; a restart skips
+        the quarantined chunk instead of re-wedging on it."""
+        chunks = self._chunks(4)
+        outdir = str(tmp_path)
+        ran = []
+
+        def run_one(chunk, prefix):
+            if prefix == "0003":
+                raise ValueError("poison pixel block")
+            ran.append(prefix)
+
+        with telemetry.use(telemetry.MetricsRegistry()) as reg:
+            stats = run_chunks(
+                chunks, run_one, outdir, num_processes=1,
+                process_index=0, retry_policy=FAST2, quarantine=True,
+            )
+            assert stats["run"] == 3 and stats["failed"] == 1
+            assert reg.value("kafka_shard_chunks_failed_total") == 1
+            kinds = [e["event"] for e in reg.events]
+            assert kinds.count("chunk_quarantined") == 1
+        assert sorted(ran) == ["0001", "0002", "0004"]
+        fm = failed_marker_path(outdir, "0003")
+        assert os.path.exists(fm)
+        payload = json.load(open(fm))
+        assert payload["failure_class"] == POISON
+        assert "poison pixel block" in payload["error"]
+        # Poison is never retried: exactly one attempt happened.
+        # Restart: the quarantined chunk is skipped, nothing re-runs.
+        stats2 = run_chunks(chunks, run_one, outdir, num_processes=1,
+                            process_index=0, quarantine=True)
+        assert stats2["run"] == 0 and stats2["skipped"] == 4
+        assert pending_chunks(
+            assign_chunks(chunks, 1), outdir, 0) == []
+
+    def test_transient_chunk_retry_succeeds(self, tmp_path):
+        chunks = self._chunks(3)
+        faults.script("scheduler.run_one", "2")  # 2nd chunk, 1st try
+        done = []
+        with telemetry.use(telemetry.MetricsRegistry()) as reg:
+            stats = run_chunks(
+                chunks, lambda c, p: done.append(p), str(tmp_path),
+                num_processes=1, process_index=0,
+                retry_policy=FAST2, quarantine=True,
+            )
+            assert reg.value("kafka_resilience_retries_total",
+                             site="scheduler.run_one") == 1
+        assert stats["run"] == 3 and stats["failed"] == 0
+        assert len(done) == 3
+        assert not os.path.exists(failed_marker_path(str(tmp_path),
+                                                     "0002"))
+
+    def test_deadline_exceeded_quarantines(self, tmp_path):
+        chunks = self._chunks(1)
+
+        def slow(chunk, prefix):
+            time.sleep(0.05)
+
+        stats = run_chunks(
+            chunks, slow, str(tmp_path), num_processes=1,
+            process_index=0, quarantine=True, chunk_deadline_s=0.01,
+        )
+        assert stats["failed"] == 1
+        payload = json.load(
+            open(failed_marker_path(str(tmp_path), "0001")))
+        assert "deadline" in payload["error"]
+
+    def test_fatal_always_propagates(self, tmp_path):
+        chunks = self._chunks(2)
+
+        def run_one(chunk, prefix):
+            raise KeyboardInterrupt
+
+        with pytest.raises(KeyboardInterrupt):
+            run_chunks(chunks, run_one, str(tmp_path), num_processes=1,
+                       process_index=0, retry_policy=FAST3,
+                       quarantine=True)
+
+    def test_default_stays_fail_fast(self, tmp_path):
+        chunks = self._chunks(2)
+        with pytest.raises(ValueError, match="boom"):
+            run_chunks(
+                chunks,
+                lambda c, p: (_ for _ in ()).throw(ValueError("boom")),
+                str(tmp_path), num_processes=1, process_index=0,
+            )
+
+    def test_done_marker_written_atomically(self, tmp_path):
+        chunks = self._chunks(1)
+        run_chunks(chunks, lambda c, p: None, str(tmp_path),
+                   num_processes=1, process_index=0)
+        mp = marker_path(str(tmp_path), "0001")
+        assert os.path.exists(mp) and not os.path.exists(mp + ".tmp")
+        assert "finished" in json.load(open(mp))
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: chaos (d) truncated newest falls back
+# ---------------------------------------------------------------------------
+
+class TestCheckpointResilience:
+    def _save_two(self, folder, n_pix=8, p=2):
+        ck = Checkpointer(str(folder))
+        rng = np.random.default_rng(0)
+        states = {}
+        for i, ts in enumerate([day(1), day(2)]):
+            x = rng.normal(size=(n_pix, p)).astype(np.float32)
+            pinv = np.stack([np.eye(p, dtype=np.float32) * (2 + i)] * n_pix)
+            ck.save(ts, x, pinv)
+            states[ts] = x
+        return ck, states
+
+    def test_atomic_save_leaves_no_tmp(self, tmp_path):
+        ck, _ = self._save_two(tmp_path)
+        assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+        assert len(ck.list_checkpoints()) == 2
+
+    def test_chaos_d_truncated_newest_falls_back(self, tmp_path):
+        ck, states = self._save_two(tmp_path)
+        newest = ck.list_checkpoints()[-1][1][0]
+        with open(newest, "r+b") as f:
+            f.truncate(40)  # torn write / partial flush
+        with telemetry.use(telemetry.MetricsRegistry()) as reg:
+            ts, x, pinv = ck.load_latest()
+            assert reg.value("kafka_checkpoint_unreadable_total") == 1
+            assert reg.events[-1]["event"] == "checkpoint_unreadable"
+        assert ts == day(1)
+        np.testing.assert_array_equal(x, states[day(1)])
+        assert pinv is not None and pinv[0, 0, 0] == 2.0
+
+    def test_empty_newest_falls_back(self, tmp_path):
+        ck, _ = self._save_two(tmp_path)
+        newest = ck.list_checkpoints()[-1][1][0]
+        open(newest, "wb").close()
+        with telemetry.use(telemetry.MetricsRegistry()):
+            ts, _, _ = ck.load_latest()
+        assert ts == day(1)
+
+    def test_all_unreadable_returns_none(self, tmp_path):
+        ck, _ = self._save_two(tmp_path)
+        for _, paths in ck.list_checkpoints():
+            for q in paths:
+                open(q, "wb").close()
+        with telemetry.use(telemetry.MetricsRegistry()):
+            assert ck.load_latest() is None
+
+    def test_resume_time_grid_uses_fallback(self, tmp_path):
+        ck, states = self._save_two(tmp_path)
+        newest = ck.list_checkpoints()[-1][1][0]
+        with open(newest, "r+b") as f:
+            f.truncate(10)
+        with telemetry.use(telemetry.MetricsRegistry()):
+            grid, seed = ck.resume_time_grid([day(i) for i in range(5)])
+        assert grid[0] == day(1) and seed is not None
+
+    def test_injected_save_fault_leaves_previous_intact(self, tmp_path):
+        ck = Checkpointer(str(tmp_path))
+        x = np.zeros((4, 2), np.float32)
+        pinv = np.stack([np.eye(2, dtype=np.float32)] * 4)
+        faults.script("checkpoint.save", "2")  # armed before call 1
+        ck.save(day(1), x, pinv)
+        with pytest.raises(faults.InjectedFault):
+            ck.save(day(2), x, pinv)
+        ckpts = ck.list_checkpoints()
+        assert [ts for ts, _ in ckpts] == [day(1)]
+        assert ck.load_latest()[0] == day(1)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the KAFKA_TPU_FAULTS-scripted chaos run of run_synthetic
+# ---------------------------------------------------------------------------
+
+#: transient read failure recovered by retry in chunk 0001 (call 2 of
+#: prefetch.read_date; retry = call 3), a date in chunk 0002 failing
+#: both attempts (calls 6-7 -> degraded, predict-only), and chunk 0003
+#: poisoned at the scheduler (3rd run_one call, never retried).
+CHAOS_SPEC = ("prefetch.read_date@2;prefetch.read_date@6-7;"
+              "scheduler.run_one@3:poison")
+
+
+def _run_synthetic_chunked(outdir, tel_dir, mask_tif):
+    from kafka_tpu.cli.run_synthetic import main
+
+    return main([
+        "--operator", "identity", "--outdir", str(outdir),
+        "--mask", str(mask_tif), "--days", "8", "--step", "4",
+        "--obs-every", "2", "--chunk-size", "16",
+        "--chunk-attempts", "2", "--read-attempts", "2",
+        "--retry-delay-s", "0.01",
+        "--telemetry-dir", str(tel_dir),
+    ])
+
+
+class TestSyntheticChaosRun:
+    def test_chaos_run_partial_success_and_bit_identical_survivors(
+            self, tmp_path, monkeypatch):
+        from kafka_tpu.io import read_geotiff, write_geotiff
+        from kafka_tpu.testing.fixtures import DEFAULT_GEO
+
+        mask_tif = tmp_path / "mask.tif"
+        write_geotiff(str(mask_tif), np.ones((32, 32), np.uint8),
+                      geo=DEFAULT_GEO)
+
+        # Fault-free reference run.
+        monkeypatch.delenv(faults.ENV_VAR, raising=False)
+        faults.reset()
+        ref = _run_synthetic_chunked(
+            tmp_path / "ref", tmp_path / "tel_ref", mask_tif)
+        assert ref["failed"] == 0 and ref["chunks_run"] == 4
+
+        # Scripted chaos run.
+        monkeypatch.setenv(faults.ENV_VAR, CHAOS_SPEC)
+        faults.reset()
+        chaos = _run_synthetic_chunked(
+            tmp_path / "chaos", tmp_path / "tel", mask_tif)
+
+        # Partial success: the poison chunk quarantined, the run
+        # completed, and the exit-code mapping signals it.
+        assert chaos["failed"] == 1
+        assert chaos["chunks_run"] == 3
+        from kafka_tpu.cli import make_console
+        assert make_console(lambda: chaos)() == EXIT_PARTIAL_SUCCESS
+        assert EXIT_PARTIAL_SUCCESS == 75
+        assert os.path.exists(
+            failed_marker_path(str(tmp_path / "chaos"), "0003"))
+
+        # Forensics: quarantine + degraded-date (and injection/retry)
+        # events are all in events.jsonl.
+        events = [json.loads(line) for line in
+                  open(tmp_path / "tel" / "events.jsonl")]
+        kinds = [e["event"] for e in events]
+        for expected in ("fault_injected", "retry", "retry_exhausted",
+                         "date_degraded", "chunk_quarantined",
+                         "run_done"):
+            assert expected in kinds, f"missing {expected} in {kinds}"
+        quarantined = [e for e in events
+                       if e["event"] == "chunk_quarantined"][0]
+        assert quarantined["prefix"] == "0003"
+
+        # Unaffected chunks (0001 recovered via retry, 0004 untouched)
+        # are BIT-IDENTICAL to the fault-free run.
+        for prefix in ("0001", "0004"):
+            ref_files = sorted(
+                f for f in os.listdir(tmp_path / "ref")
+                if f.endswith(".tif") and f"_{prefix}" in f
+            )
+            chaos_files = sorted(
+                f for f in os.listdir(tmp_path / "chaos")
+                if f.endswith(".tif") and f"_{prefix}" in f
+            )
+            assert ref_files == chaos_files and ref_files
+            for fn in ref_files:
+                a, _ = read_geotiff(str(tmp_path / "ref" / fn))
+                b, _ = read_geotiff(str(tmp_path / "chaos" / fn))
+                np.testing.assert_array_equal(a, b, err_msg=fn)
+        # The degraded chunk still produced outputs (predict-only for
+        # the failed date), and the quarantined one wrote no .done.
+        assert any("_0002" in f for f in os.listdir(tmp_path / "chaos"))
+        assert not os.path.exists(
+            marker_path(str(tmp_path / "chaos"), "0003"))

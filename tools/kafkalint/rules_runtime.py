@@ -14,6 +14,15 @@ justification comment.  The accepted justification form is a trailing
 comment on the ``except`` line (or a comment line opening the handler
 body) that says *why* swallowing is correct — kafkalint/expect directives
 and bare ``noqa`` codes do not count.
+
+``ad-hoc-retry`` encodes the resilience-layer convention (ISSUE 6):
+``time.sleep`` outside ``kafka_tpu/resilience/`` is a hand-rolled
+backoff/poll — inside a loop it is an ad-hoc retry loop that must go
+through ``resilience.RetryPolicy`` (classified failures, counted retries,
+injectable sleep); straight-line sleeps are flagged too, so waits either
+move behind the policy layer or carry an inline suppression saying why
+not (``telemetry/health.py``'s single probe re-read is the production
+example).
 """
 
 from __future__ import annotations
@@ -184,3 +193,62 @@ class BareExcept(Rule):
             if re.search(r"[A-Za-z]{2}", stripped):
                 return True
         return False
+
+
+@register
+class AdHocRetry(Rule):
+    name = "ad-hoc-retry"
+    description = (
+        "time.sleep outside kafka_tpu/resilience/ — hand-rolled "
+        "backoff/poll loops must go through resilience.RetryPolicy "
+        "(classified failures, counted retries, injectable sleep); "
+        "inline-suppress the rare justified wait"
+    )
+
+    #: the one module allowed to sleep: the policy layer itself.
+    EXEMPT_PREFIX = "kafka_tpu/resilience/"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None or ctx.rel.startswith(self.EXEMPT_PREFIX):
+            return ()
+        findings: List[Finding] = []
+        self._scan(ctx, ctx.tree, False, findings)
+        return findings
+
+    def _scan(self, ctx: FileContext, node: ast.AST, in_loop: bool,
+              findings: List[Finding]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.Call) and self._is_sleep(child):
+                if in_loop:
+                    msg = (
+                        "time.sleep inside a loop is a hand-rolled "
+                        "backoff — retry through "
+                        "kafka_tpu.resilience.RetryPolicy instead"
+                    )
+                else:
+                    msg = (
+                        "ad-hoc time.sleep wait — route retries/backoff "
+                        "through kafka_tpu.resilience.RetryPolicy, or "
+                        "justify the wait with an inline suppression"
+                    )
+                findings.append(Finding(
+                    path=ctx.rel, line=child.lineno, rule=self.name,
+                    message=msg,
+                ))
+            self._scan(
+                ctx, child,
+                in_loop or isinstance(
+                    child, (ast.For, ast.While, ast.AsyncFor)
+                ),
+                findings,
+            )
+
+    @staticmethod
+    def _is_sleep(call: ast.Call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr == "sleep":
+            # time.sleep / aliased-module sleep; object methods named
+            # .sleep on non-module receivers are out of scope.
+            base = jitscan.tail(f.value) or ""
+            return "time" in base
+        return isinstance(f, ast.Name) and f.id == "sleep"
