@@ -1,19 +1,34 @@
-"""Single-chunk subprocess worker for device-OOM recovery.
+"""Chunk worker subprocess: one chunk (OOM recovery) or a whole queue.
 
-On this class of TPU runtime, one RESOURCE_EXHAUSTED poisons the process's
-device client permanently (every later allocation fails, even 1 MB —
-measured), so OOM recovery cannot happen in-process: the failed chunk's
-quarters must run in fresh processes with their own clients.  This module
-is that fresh process: it runs exactly one chunk via ``run_one_chunk``
-and reports the summary as one JSON line on stdout.
+**Single-chunk mode** (positional args — emitted by
+``run_one_chunk_resilient``, not user-facing): on this class of TPU
+runtime, one RESOURCE_EXHAUSTED poisons the process's device client
+permanently (every later allocation fails, even 1 MB — measured), so OOM
+recovery cannot happen in-process: the failed chunk's quarters must run
+in fresh processes with their own clients.  This module is that fresh
+process: it runs exactly one chunk via ``run_one_chunk`` and reports the
+summary as one JSON line on stdout.
 
 Exit codes: 0 success (JSON on stdout; ``null`` for an empty-mask chunk),
 17 device OOM (the parent splits and retries), anything else = real error
 (propagated by the parent).
 
-Usage (emitted by ``run_one_chunk_resilient`` — not user-facing):
     python -m kafka_tpu.cli.chunk_worker <config.json> <x0> <y0> \
         <nx_valid> <ny_valid> <chunk_no> <prefix>
+
+**Queue mode** (``--queue`` — the ROADMAP's "per-host worker over a
+shared chunk queue"): the process becomes one self-healing worker
+claiming chunks from the config's ``output_folder`` via lease files
+(``shard.run_queue`` — BASELINE.md "Multi-host queue").  Run one per
+host against a shared filesystem; a worker that dies has its chunks
+reclaimed by the survivors.  ``--num-workers N`` spawns a local
+N-process fleet from this one command:
+
+    python -m kafka_tpu.cli.chunk_worker --queue config.json \
+        --lease-ttl-s 30 --num-workers 4
+
+Queue-mode exit codes: 0 all chunks done (or a clean SIGTERM drain), 75
+when chunks were quarantined (partial success — rerun after fixing).
 """
 
 from __future__ import annotations
@@ -25,8 +40,61 @@ import sys
 OOM_EXIT_CODE = 17
 
 
+def _queue_main(argv) -> int:
+    """``--queue`` worker mode (see module docstring)."""
+    import argparse
+    import subprocess
+
+    ap = argparse.ArgumentParser(
+        prog="chunk_worker --queue",
+        description="self-healing queue worker over a RunConfig",
+    )
+    ap.add_argument("config", help="RunConfig JSON")
+    ap.add_argument("--lease-ttl-s", type=float, default=None,
+                    help="heartbeat-lease TTL; a worker silent this long "
+                         "is presumed dead and its chunk is reclaimed")
+    ap.add_argument("--num-workers", type=int, default=1,
+                    help="local fleet size (N>1 spawns N single-worker "
+                         "subprocesses of this command and waits)")
+    args = ap.parse_args(argv)
+
+    if args.num_workers > 1:
+        cmd = [sys.executable, "-m", "kafka_tpu.cli.chunk_worker",
+               "--queue", args.config, "--num-workers", "1"]
+        if args.lease_ttl_s is not None:
+            cmd += ["--lease-ttl-s", str(args.lease_ttl_s)]
+        env = dict(os.environ)
+        # All workers join one trace: new_run_id() picks this up.
+        env.setdefault("KAFKA_TPU_RUN_ID", os.urandom(6).hex())
+        procs = [subprocess.Popen(cmd, env=env)
+                 for _ in range(args.num_workers)]
+        rcs = [p.wait() for p in procs]
+        hard = [rc for rc in rcs if rc not in (0, 75)]
+        if hard:
+            return hard[0]
+        return 75 if 75 in rcs else 0
+
+    from ..engine.config import RunConfig
+    from .drivers import resolve_aux_builder, run_config
+
+    cfg = RunConfig.load(args.config)
+    stats = run_config(
+        cfg, resolve_aux_builder(cfg), queue=True,
+        lease_ttl_s=args.lease_ttl_s,
+    )
+    print(json.dumps(stats))
+    if stats.get("failed"):
+        from ..resilience import EXIT_PARTIAL_SUCCESS
+
+        return EXIT_PARTIAL_SUCCESS
+    return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if "--queue" in argv:
+        argv.remove("--queue")
+        return _queue_main(argv)
     cfg_path, x0, y0, nx, ny, chunk_no, prefix = argv
     from ..engine.config import RunConfig
     from ..io.tiling import Chunk

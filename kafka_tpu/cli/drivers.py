@@ -488,11 +488,20 @@ def run_config(
     aux_builder: Optional[Callable] = None,
     num_processes: Optional[int] = None,
     process_index: Optional[int] = None,
+    queue: bool = False,
+    lease_ttl_s: Optional[float] = None,
 ) -> dict:
     """Chunked run over the whole state mask — the ``__main__`` of every
     reference driver, including the dask fan-out (serial loop and
     distributed execution are the same code path here;
-    ``kafka_test_S2.py:196-205`` vs ``kafka_test_Py36.py:242-255``)."""
+    ``kafka_test_S2.py:196-205`` vs ``kafka_test_Py36.py:242-255``).
+
+    ``queue=True`` replaces the static round-robin with the self-healing
+    lease-based chunk queue (``shard.run_queue``): this process becomes
+    one worker claiming from the shared ``output_folder`` queue, a dying
+    worker's chunks are reclaimed by survivors after ``lease_ttl_s``,
+    and ``num_processes``/``process_index`` are irrelevant — the queue
+    needs no assignment (BASELINE.md "Multi-host queue")."""
     from ..resilience import RetryPolicy, faults
     from ..telemetry import (
         configure, flight_recorder, get_registry,
@@ -514,7 +523,9 @@ def run_config(
     full_mask, geo = load_state_mask(cfg)
     ny, nx = full_mask.shape
     chunks = list(get_chunks(nx, ny, tuple(cfg.chunk_size)))
-    summaries = []
+    # Keyed by prefix, not appended: queue-mode at-least-once execution
+    # (commit retries, reclaims) may run a chunk twice.
+    summaries = {}
     # One operator for ALL chunks — keeps the jitted solver's compile
     # cache warm across the chunk loop (see run_one_chunk).
     operator = cfg.make_operator()
@@ -525,7 +536,7 @@ def run_config(
             operator=operator,
         )
         if s is not None:
-            summaries.append(s)
+            summaries[prefix] = s
             LOG.info("chunk %s: %s", prefix, json.dumps(s))
 
     # Fault-tolerance knobs ride RunConfig.extra["fault_tolerance"]:
@@ -544,19 +555,36 @@ def run_config(
     # One trace context for the whole run: chunk/window ids are pushed
     # below it, and the recorder guard dumps on the way out of a failure.
     with tracing.push(run_id=tracing.new_run_id()), recorder:
-        stats = run_chunks(
-            chunks, run_one, cfg.output_folder,
-            num_processes=num_processes, process_index=process_index,
-            retry_policy=retry_policy,
-            quarantine=bool(ft.get("quarantine", False)),
-            chunk_deadline_s=(
-                float(deadline_s) if deadline_s is not None else None
-            ),
-        )
+        if queue:
+            from ..shard.queue import DEFAULT_LEASE_TTL_S, run_queue
+
+            stats = run_queue(
+                chunks, run_one, cfg.output_folder,
+                lease_ttl_s=(lease_ttl_s if lease_ttl_s
+                             else DEFAULT_LEASE_TTL_S),
+                retry_policy=retry_policy,
+                quarantine=bool(ft.get("quarantine", True)),
+                chunk_deadline_s=(
+                    float(deadline_s) if deadline_s is not None else None
+                ),
+                max_requeues=ft.get("max_requeues"),
+            )
+        else:
+            stats = run_chunks(
+                chunks, run_one, cfg.output_folder,
+                num_processes=num_processes, process_index=process_index,
+                retry_policy=retry_policy,
+                quarantine=bool(ft.get("quarantine", False)),
+                chunk_deadline_s=(
+                    float(deadline_s) if deadline_s is not None else None
+                ),
+            )
     stats["chunks_with_pixels"] = len(summaries)
-    stats["pixels"] = int(sum(s["n_pixels"] for s in summaries))
+    stats["pixels"] = int(
+        sum(s["n_pixels"] for s in summaries.values())
+    )
     stats["dates_assimilated"] = int(
-        sum(s["n_dates_assimilated"] for s in summaries)
+        sum(s["n_dates_assimilated"] for s in summaries.values())
     )
     reg = get_registry()
     reg.emit("run_done", **stats)

@@ -17,6 +17,14 @@ makes this driver the fault-tolerance chaos harness: script failures
 with ``KAFKA_TPU_FAULTS`` (see ``kafka_tpu.resilience.faults``) and the
 run completes with exit code 75 (partial success) when chunks were
 quarantined, while unaffected chunks produce bit-identical outputs.
+
+``--queue`` upgrades chunked mode to the self-healing lease-based queue
+(``shard.run_queue``): workers claim chunks via heartbeat leases and
+reclaim a dead worker's expired leases, so a SIGKILLed worker's chunks
+are finished by the survivors.  ``--num-workers N`` makes a local
+N-process fleet out of this one command (the chaos recipe in BASELINE.md
+"Multi-host queue"); SIGTERM drains a worker gracefully (finish current
+chunk, release leases, exit 0).
 """
 
 from __future__ import annotations
@@ -99,6 +107,7 @@ def _mean_prior(mean, sigma):
 def main(argv=None):
     from ..utils.compilation_cache import enable_compilation_cache
 
+    raw_argv = list(sys.argv[1:] if argv is None else argv)
     enable_compilation_cache()
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--operator", default="twostream",
@@ -117,6 +126,18 @@ def main(argv=None):
     ap.add_argument("--chunk-size", type=int, default=0,
                     help="run as NxN chunks through the restart-safe "
                          "scheduler with quarantine on (0 = one run)")
+    ap.add_argument("--queue", action="store_true",
+                    help="claim chunks from the self-healing lease-based "
+                         "queue (shard.run_queue) instead of static "
+                         "assignment; requires --chunk-size")
+    ap.add_argument("--lease-ttl-s", type=float, default=None,
+                    help="queue-mode heartbeat-lease TTL; a worker "
+                         "silent this long is presumed dead and its "
+                         "chunk is reclaimed")
+    ap.add_argument("--num-workers", type=int, default=1,
+                    help="queue-mode local fleet size: N>1 spawns N "
+                         "single-worker subprocesses of this command "
+                         "over one shared queue and waits")
     ap.add_argument("--chunk-attempts", type=int, default=2,
                     help="attempts per chunk under the scheduler retry "
                          "policy (chunked mode)")
@@ -139,6 +160,13 @@ def main(argv=None):
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING
     )
+    if args.queue and args.chunk_size <= 0:
+        raise SystemExit("--queue requires --chunk-size")
+    if args.queue and args.num_workers > 1:
+        # Local fleet: the parent only spawns + waits + summarises; the
+        # children are plain single-worker copies of this command over
+        # the one shared filesystem queue.
+        return _run_fleet(args, raw_argv)
     from ..telemetry import (
         configure, flight_recorder, get_registry,
         install_compile_listeners, tracing,
@@ -270,7 +298,9 @@ def _run_chunked(args, mask, geo, op, params, prior, truth, aux_fn,
 
     ny, nx = mask.shape
     chunks = list(get_chunks(nx, ny, (args.chunk_size, args.chunk_size)))
-    summaries = []
+    # Keyed by prefix, not appended: at-least-once execution (queue-mode
+    # commit retries, reclaimed chunks) may run a chunk twice.
+    summaries = {}
 
     def run_one(chunk, prefix):
         sub_mask = chunk_mask(mask, chunk)
@@ -304,14 +334,36 @@ def _run_chunked(args, mask, geo, op, params, prior, truth, aux_fn,
             output.close()
             raise
         output.close()
-        summaries.append({
+        summaries[prefix] = {
             "prefix": prefix, "n_pixels": int(kf.gather.n_valid),
-        })
+        }
 
     policy = RetryPolicy(
         max_attempts=max(1, args.chunk_attempts),
         base_delay=args.retry_delay_s, multiplier=2.0, jitter=0.0,
     ) if args.chunk_attempts > 1 else None
+    if args.queue:
+        from ..shard.queue import DEFAULT_LEASE_TTL_S, run_queue
+
+        stats = run_queue(
+            chunks, run_one, args.outdir,
+            lease_ttl_s=(args.lease_ttl_s if args.lease_ttl_s
+                         else DEFAULT_LEASE_TTL_S),
+            retry_policy=policy, quarantine=True,
+            chunk_deadline_s=args.chunk_deadline_s,
+        )
+        return {
+            "mode": "queue",
+            "worker": stats["worker"],
+            "chunks_total": stats["total"],
+            "chunks_run": stats["run"],
+            "reclaimed": stats["reclaimed"],
+            "skipped": stats["skipped"],
+            "failed": stats["failed"],
+            "drained": stats["drained"],
+            "pending": stats["pending_at_exit"],
+            "n_pixels": int(sum(s["n_pixels"] for s in summaries.values())),
+        }
     stats = run_chunks(
         chunks, run_one, args.outdir, num_processes=1, process_index=0,
         retry_policy=policy, quarantine=True,
@@ -323,8 +375,71 @@ def _run_chunked(args, mask, geo, op, params, prior, truth, aux_fn,
         "chunks_run": stats["run"],
         "skipped": stats["skipped"],
         "failed": stats["failed"],
-        "n_pixels": int(sum(s["n_pixels"] for s in summaries)),
+        "n_pixels": int(sum(s["n_pixels"] for s in summaries.values())),
     }
+
+
+def _strip_flag(argv, name, has_value=True):
+    """Remove ``name <v>`` / ``name=<v>`` occurrences from an argv list."""
+    out, skip = [], False
+    for tok in argv:
+        if skip:
+            skip = False
+            continue
+        if tok == name:
+            skip = has_value
+            continue
+        if tok.startswith(name + "="):
+            continue
+        out.append(tok)
+    return out
+
+
+def _run_fleet(args, raw_argv) -> dict:
+    """``--queue --num-workers N``: the one-command local fleet.  Spawns
+    N single-worker copies of this command over the shared queue in
+    ``--outdir``, waits, and summarises the queue's final state — the
+    chaos recipe from BASELINE.md "Multi-host queue" (SIGKILL a worker
+    mid-run and the survivors reclaim its chunks)."""
+    import subprocess
+
+    from ..shard.queue import queue_status
+
+    child_argv = raw_argv
+    for flag in ("--num-workers", "--telemetry-dir"):
+        child_argv = _strip_flag(child_argv, flag)
+    env = dict(os.environ)
+    # One run id for the whole fleet: every worker's spans/events join
+    # one trace (tracing.new_run_id reads this).
+    env.setdefault("KAFKA_TPU_RUN_ID", os.urandom(6).hex())
+    procs = []
+    for i in range(args.num_workers):
+        cmd = [sys.executable, "-m", "kafka_tpu.cli.run_synthetic",
+               *child_argv, "--num-workers", "1"]
+        if args.telemetry_dir:
+            cmd += ["--telemetry-dir",
+                    os.path.join(args.telemetry_dir, f"worker_{i}")]
+        procs.append(subprocess.Popen(cmd, env=env,
+                                      stdout=subprocess.DEVNULL))
+    rcs = [p.wait() for p in procs]
+    hard = [rc for rc in rcs if rc not in (0, 75)]
+    if hard:
+        raise RuntimeError(
+            f"queue worker hard-failed (rc={hard[0]}; all: {rcs})"
+        )
+    status = queue_status(args.outdir)
+    summary = {
+        "mode": "queue-fleet",
+        "num_workers": args.num_workers,
+        "chunks_total": status["n_chunks"],
+        "done": status["counts"]["done"],
+        "failed": status["counts"]["failed"],
+        "pending": status["counts"]["pending"],
+        "worker_rcs": rcs,
+        "outdir": args.outdir,
+    }
+    print(json.dumps(summary))
+    return summary
 
 
 console = make_console(main)

@@ -20,6 +20,7 @@ individually-rereadable pieces instead of one monolithic array.
 from __future__ import annotations
 
 import datetime
+import itertools
 import logging
 import os
 import re
@@ -41,6 +42,12 @@ _RX = re.compile(r"state_(\d{8}T\d{6})(?:\.shard(\d+)of(\d+))?\.npz$")
 _UNREADABLE_ERRORS = (
     OSError, EOFError, ValueError, KeyError, zipfile.BadZipFile,
 )
+
+#: per-process tmp-name counter: with the pid it makes every writer's tmp
+#: unique, so two processes checkpointing into one folder (chunk workers,
+#: queue-mode reruns of the same chunk) can never interleave open and
+#: ``os.replace`` on a shared fixed-name tmp and commit a torn file.
+_TMP_COUNTER = itertools.count()
 
 
 def pack_tril(a: np.ndarray) -> np.ndarray:
@@ -105,8 +112,11 @@ class Checkpointer:
             # truncated .npz under the FINAL name (load_latest would
             # have treated it as the newest complete checkpoint).  The
             # tmp is written through a file handle so np.savez doesn't
-            # append its own .npz suffix.
-            tmp = path + ".tmp"
+            # append its own .npz suffix; its name is unique per writer
+            # (pid + counter) so concurrent savers can't tear each
+            # other's writes, and a crash-leaked tmp is removed by the
+            # scheduler's startup sweep (``shard.sweep_stale_tmp``).
+            tmp = f"{path}.tmp.{os.getpid()}.{next(_TMP_COUNTER)}"
             with open(tmp, "wb") as f:
                 np.savez_compressed(
                     f,

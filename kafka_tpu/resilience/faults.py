@@ -16,7 +16,17 @@ In-repo sites:
                         worker thread AND the synchronous
                         ``prefetch_depth=0`` path)
 ``scheduler.run_one``   one chunk execution attempt in
-                        ``shard.scheduler.run_chunks``
+                        ``shard.scheduler.run_chunks`` and
+                        ``shard.queue.run_queue``
+``scheduler.claim``     one lease-claim attempt in the multi-host queue
+                        (``shard.queue._try_claim`` — fresh claims and
+                        reclaims both)
+``scheduler.heartbeat`` one lease renewal on the queue worker's
+                        background heartbeat thread
+``scheduler.commit``    the ``.done`` commit of a queue-run chunk (fires
+                        BEFORE ``mark_done``, so a transient commit
+                        failure re-runs the chunk — the at-least-once
+                        double-execution path)
 ``checkpoint.save``     one checkpoint shard write in
                         ``engine.checkpoint.Checkpointer.save``
 ================== ====================================================

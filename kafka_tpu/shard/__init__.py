@@ -4,7 +4,9 @@ The TPU-native replacement for the reference's two distribution layers:
 pixels within a chunk shard over the device mesh via GSPMD (``mesh``,
 ``step``), whole chunks/tiles distribute across hosts via a deterministic
 work queue (``scheduler`` — the dask-equivalent of
-``kafka_test_Py36.py:242-255``).
+``kafka_test_Py36.py:242-255``) or, self-healingly, via the lease-based
+shared chunk queue (``queue`` — claims, heartbeats and crash-reclaim, so
+a dead host's chunks are picked up by survivors instead of stranding).
 """
 
 from .mesh import (
@@ -17,6 +19,12 @@ from .mesh import (
     shard_bands,
     shard_state,
 )
+from .queue import (
+    DEFAULT_LEASE_TTL_S,
+    lease_path,
+    queue_status,
+    run_queue,
+)
 from .scheduler import (
     ChunkAssignment,
     assign_chunks,
@@ -25,6 +33,7 @@ from .scheduler import (
     mark_failed,
     pending_chunks,
     run_chunks,
+    sweep_stale_tmp,
 )
 from .step import make_sharded_forward, make_sharded_step
 
@@ -38,12 +47,17 @@ __all__ = [
     "shard_bands",
     "shard_state",
     "ChunkAssignment",
+    "DEFAULT_LEASE_TTL_S",
     "assign_chunks",
     "failed_marker_path",
+    "lease_path",
     "mark_done",
     "mark_failed",
     "pending_chunks",
+    "queue_status",
     "run_chunks",
+    "run_queue",
+    "sweep_stale_tmp",
     "make_sharded_forward",
     "make_sharded_step",
 ]

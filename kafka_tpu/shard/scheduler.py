@@ -28,7 +28,16 @@ replacement:
   run CONTINUES and ``pending_chunks`` skips it on restart.  The nonzero
   ``failed`` count in the returned stats becomes the drivers'
   partial-success exit code.  The default (no policy, no quarantine)
-  keeps the historical fail-fast behaviour.
+  keeps the historical fail-fast behaviour;
+- **self-healing across hosts** (``run_queue`` / ``shard.queue``): the
+  static round-robin strands a dead host's chunks until a human
+  restarts the job, so the queue mode replaces assignment with
+  lease-based CLAIMING — atomic ``.chunk_<prefix>.lease`` markers with
+  heartbeat deadlines, renewed from a background thread; any worker
+  that finds an expired lease reclaims the chunk.  At-least-once
+  execution made safe by the per-chunk-prefixed atomic outputs
+  (a second completion overwrites with identical bytes; ``.done`` wins
+  over any stale lease).  See BASELINE.md "Multi-host queue".
 
 ``run_chunks`` records completion counters, per-chunk wall-time histograms
 and straggler flags into the telemetry registry — the scheduler-level
@@ -37,9 +46,11 @@ slice of the observability layer (BASELINE.md "Observability").
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import os
+import re
 import statistics
 import time
 from dataclasses import dataclass
@@ -97,14 +108,67 @@ def failed_marker_path(outdir: str, prefix: str) -> str:
     return os.path.join(outdir, f".chunk_{prefix}.failed")
 
 
+#: per-process tmp-name counter: together with the pid it makes every
+#: writer's tmp unique, so two hosts racing on the SAME marker (lease
+#: contention) can never interleave open/os.replace on one tmp file and
+#: commit a torn payload.
+_TMP_COUNTER = itertools.count()
+
+#: tmp files left by a crash between open and os.replace — both the
+#: legacy fixed ``.tmp`` suffix and the unique ``.tmp.<pid>.<n>`` form.
+_TMP_RX = re.compile(r"\.tmp(\.\d+\.\d+)?$")
+
+
+def _tmp_name(path: str) -> str:
+    """A tmp name unique to this writer (pid + counter)."""
+    return f"{path}.tmp.{os.getpid()}.{next(_TMP_COUNTER)}"
+
+
 def _write_marker(path: str, payload: dict) -> None:
     """Atomic marker write: a crash mid-write must never leave an empty
-    marker that suppresses a rerun (tmp + ``os.replace``, same pattern
-    as ``engine.checkpoint``)."""
-    tmp = path + ".tmp"
+    marker that suppresses a rerun (unique tmp + ``os.replace``, same
+    pattern as ``engine.checkpoint``)."""
+    tmp = _tmp_name(path)
     with open(tmp, "w") as f:
         json.dump(payload, f)
     os.replace(tmp, path)
+
+
+def sweep_stale_tmp(outdir: str, older_than_s: float = 60.0) -> List[str]:
+    """Remove orphaned ``*.tmp`` marker/checkpoint files (recursive).
+
+    A crash between ``open`` and ``os.replace`` leaks the tmp forever;
+    this sweep runs on scheduler startup (``run_chunks`` / ``run_queue``)
+    and clears them.  ``older_than_s`` protects writers that are mid-write
+    RIGHT NOW on another host — a live atomic write completes in
+    milliseconds, so anything older than a minute is a corpse."""
+    removed: List[str] = []
+    if not os.path.isdir(outdir):
+        return removed
+    now = time.time()
+    reg = get_registry()
+    for dirpath, _dirnames, filenames in os.walk(outdir):
+        for fn in filenames:
+            if not _TMP_RX.search(fn):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                if now - os.path.getmtime(path) < older_than_s:
+                    continue
+                os.unlink(path)
+            except OSError:  # raced another sweeper, or vanished
+                continue
+            removed.append(path)
+            reg.counter(
+                "kafka_scheduler_stale_tmp_removed_total",
+                "orphaned .tmp marker/checkpoint files removed by the "
+                "startup sweep (crash between open and os.replace)",
+            ).inc()
+            reg.emit(
+                "stale_tmp_removed",
+                path=os.path.relpath(path, outdir),
+            )
+    return removed
 
 
 def mark_done(outdir: str, prefix: str, payload: Optional[dict] = None) -> None:
@@ -133,6 +197,36 @@ def pending_chunks(assignments: Iterable[ChunkAssignment], outdir: str,
     ]
 
 
+def chunk_metrics(reg) -> dict:
+    """The chunk-level metric vocabulary, registered at its ONE literal
+    site (the metric-name lint requires exactly one registration site per
+    name; ``run_chunks`` and ``queue.run_queue`` share these handles)."""
+    return {
+        "done": reg.counter(
+            "kafka_shard_chunks_completed_total",
+            "chunks run to completion (.done marker written)",
+        ),
+        "wall": reg.histogram(
+            "kafka_shard_chunk_seconds",
+            "wall seconds per completed chunk",
+        ),
+        "pending": reg.gauge(
+            "kafka_shard_chunks_pending",
+            "this process's chunks still to run",
+        ),
+        "stragglers": reg.counter(
+            "kafka_shard_stragglers_total",
+            "completed chunks slower than STRAGGLER_FACTOR x the median "
+            "of prior completions",
+        ),
+        "failed": reg.counter(
+            "kafka_shard_chunks_failed_total",
+            "chunks quarantined after exhausting retries (.failed marker "
+            "written, run continued)",
+        ),
+    }
+
+
 def run_chunks(
     chunks: Sequence[Chunk],
     run_one: Callable[[Chunk, str], None],
@@ -159,6 +253,7 @@ def run_chunks(
     ``failed`` count instead of aborting the run.  Defaults preserve the
     historical fail-fast semantics exactly."""
     os.makedirs(outdir, exist_ok=True)
+    sweep_stale_tmp(outdir)
     assignments = assign_chunks(chunks, num_processes)
     todo = pending_chunks(assignments, outdir, process_index)
     stats = {"assigned": len([a for a in assignments if a.owner ==
@@ -167,28 +262,10 @@ def run_chunks(
              "run": 0, "skipped": 0, "failed": 0, "wall_s": 0.0}
     stats["skipped"] = stats["assigned"] - len(todo)
     reg = get_registry()
-    m_done = reg.counter(
-        "kafka_shard_chunks_completed_total",
-        "chunks run to completion (.done marker written)",
-    )
-    m_wall = reg.histogram(
-        "kafka_shard_chunk_seconds",
-        "wall seconds per completed chunk",
-    )
-    m_pending = reg.gauge(
-        "kafka_shard_chunks_pending",
-        "this process's chunks still to run",
-    )
-    m_straggle = reg.counter(
-        "kafka_shard_stragglers_total",
-        "completed chunks slower than STRAGGLER_FACTOR x the median of "
-        "prior completions",
-    )
-    m_failed = reg.counter(
-        "kafka_shard_chunks_failed_total",
-        "chunks quarantined after exhausting retries (.failed marker "
-        "written, run continued)",
-    )
+    metrics = chunk_metrics(reg)
+    m_done, m_wall = metrics["done"], metrics["wall"]
+    m_pending, m_failed = metrics["pending"], metrics["failed"]
+    m_straggle = metrics["stragglers"]
     m_pending.set(len(todo))
     walls: List[float] = []
     t0 = time.time()
@@ -269,3 +346,14 @@ def run_chunks(
         )
     stats["wall_s"] = time.time() - t0
     return stats
+
+
+def run_queue(chunks: Sequence[Chunk], run_one: Callable[[Chunk, str], None],
+              outdir: str, **kwargs) -> dict:
+    """Self-healing multi-host execution: lease-based claiming over a
+    shared filesystem queue instead of static assignment.  Thin
+    delegation to :func:`kafka_tpu.shard.queue.run_queue` (lazy import —
+    the queue module builds on this one)."""
+    from .queue import run_queue as _run_queue
+
+    return _run_queue(chunks, run_one, outdir, **kwargs)

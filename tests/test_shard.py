@@ -3,6 +3,7 @@ multi-device test the reference entirely lacks)."""
 
 import datetime
 import os
+import time
 
 import jax
 import jax.numpy as jnp
@@ -146,6 +147,86 @@ def test_scheduler_crash_midway_reruns_exactly_missing(tmp_path):
     assert stats["skipped"] == 3 and stats["run"] == len(mine) - 3
     assert set(rerun).isdisjoint(ran)
     assert pending_chunks(assign_chunks(chunks, 2), outdir, 0) == []
+
+
+def test_legacy_failed_marker_payloads_honored(tmp_path):
+    """Regression (ISSUE 7): a ``.failed`` marker with a pre-PR-6
+    payload (no failure_class) or an empty/unparseable body must still
+    be honoured by ``pending_chunks``, the queue scan and ``run_chunks``
+    — never crash, never re-run the quarantined chunk."""
+    import json as _json
+
+    from kafka_tpu.shard.queue import queue_status, scan_chunk
+    from kafka_tpu.shard.scheduler import failed_marker_path, run_chunks
+
+    chunks = list(get_chunks(512, 512, (128, 128)))[:4]
+    outdir = str(tmp_path)
+    # Pre-PR-6 payload: just a timestamp, no failure_class/error.
+    with open(failed_marker_path(outdir, "0001"), "w") as f:
+        _json.dump({"failed": 1234.5}, f)
+    # Worst case: an empty file (torn write predating atomic markers).
+    open(failed_marker_path(outdir, "0002"), "wb").close()
+    assignments = assign_chunks(chunks, num_processes=1)
+    pending = pending_chunks(assignments, outdir, 0)
+    assert [a.prefix for a in pending] == ["0003", "0004"]
+    assert scan_chunk(outdir, "0001").state == "failed"
+    assert scan_chunk(outdir, "0002").state == "failed"
+    ran = []
+    stats = run_chunks(chunks, lambda c, p: ran.append(p), outdir,
+                       num_processes=1, process_index=0)
+    assert sorted(ran) == ["0003", "0004"]
+    assert stats["skipped"] == 2
+    status = queue_status(outdir)
+    assert status["counts"]["failed"] == 2
+
+
+def test_write_marker_tmp_names_are_unique(tmp_path):
+    """Regression (ISSUE 7): the fixed ``path + '.tmp'`` name let two
+    hosts racing on one marker interleave open/os.replace and commit a
+    torn payload — tmp names now carry pid + a per-process counter."""
+    from kafka_tpu.shard.scheduler import _tmp_name, _write_marker
+
+    target = str(tmp_path / ".chunk_0001.done")
+    names = {_tmp_name(target) for _ in range(16)}
+    assert len(names) == 16
+    assert all(f".tmp.{os.getpid()}." in n for n in names)
+    _write_marker(target, {"finished": True})
+    assert os.path.exists(target)
+    assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+
+
+def test_sweep_stale_tmp_removes_orphans(tmp_path):
+    """A crash between open and os.replace leaks the tmp forever; the
+    scheduler startup sweep removes old ones (recursively — checkpoint
+    tmps included) and records an event per file, while a fresh tmp
+    (a write in flight on another host) is left alone."""
+    from kafka_tpu import telemetry
+    from kafka_tpu.shard.scheduler import sweep_stale_tmp
+
+    outdir = tmp_path
+    (outdir / "ckpt").mkdir()
+    legacy = outdir / ".chunk_0001.done.tmp"
+    unique = outdir / f".chunk_0002.failed.tmp.{os.getpid()}.7"
+    ckpt = outdir / "ckpt" / "state_20170101T000000.npz.tmp"
+    fresh = outdir / ".chunk_0003.done.tmp"
+    for p in (legacy, unique, ckpt, fresh):
+        p.write_bytes(b"orphan")
+    old = time.time() - 3600
+    for p in (legacy, unique, ckpt):
+        os.utime(p, (old, old))
+    # A real output file must never be touched.
+    keeper = outdir / "a_A2017184_0001.tif"
+    keeper.write_bytes(b"data")
+    with telemetry.use(telemetry.MetricsRegistry()) as reg:
+        removed = sweep_stale_tmp(str(outdir), older_than_s=60.0)
+        assert reg.value("kafka_scheduler_stale_tmp_removed_total") == 3
+        events = [e for e in reg.events
+                  if e["event"] == "stale_tmp_removed"]
+        assert len(events) == 3
+    assert len(removed) == 3
+    assert not legacy.exists() and not unique.exists() \
+        and not ckpt.exists()
+    assert fresh.exists() and keeper.exists()
 
 
 def test_scheduler_records_telemetry(tmp_path):

@@ -23,6 +23,13 @@ injectable sleep); straight-line sleeps are flagged too, so waits either
 move behind the policy layer or carry an inline suppression saying why
 not (``telemetry/health.py``'s single probe re-read is the production
 example).
+
+``naive-marker-write`` encodes the queue-protocol convention (ISSUE 7):
+the ``.done``/``.failed``/``.lease`` markers ARE the multi-host
+coordination protocol, and a plain ``open(path, "w")`` on one is a torn
+half-written marker waiting to happen (another host can read it
+mid-write) — every marker write must go through the atomic
+``_write_marker`` helpers (unique tmp + ``os.replace``).
 """
 
 from __future__ import annotations
@@ -252,3 +259,65 @@ class AdHocRetry(Rule):
             base = jitscan.tail(f.value) or ""
             return "time" in base
         return isinstance(f, ast.Name) and f.id == "sleep"
+
+
+@register
+class NaiveMarkerWrite(Rule):
+    name = "naive-marker-write"
+    description = (
+        "open(..., 'w') on a .done/.failed/.lease marker path outside "
+        "the sanctioned _write_marker helpers — marker files are the "
+        "multi-host protocol and must be written atomically (unique tmp "
+        "+ os.replace), or another host reads a torn payload"
+    )
+
+    #: marker suffixes that form the queue protocol.
+    MARKERS = (".done", ".failed", ".lease")
+    #: functions allowed to touch marker paths directly (the atomic
+    #: writers themselves).
+    SANCTIONED = ("_write_marker",)
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return ()
+        findings: List[Finding] = []
+        self._scan(ctx, ctx.tree, (), findings)
+        return findings
+
+    def _scan(self, ctx: FileContext, node: ast.AST, stack, findings):
+        for child in ast.iter_child_nodes(node):
+            child_stack = stack
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_stack = stack + (child.name,)
+            if (isinstance(child, ast.Call)
+                    and self._is_marker_write(child)
+                    and not any(s in self.SANCTIONED for s in stack)):
+                findings.append(Finding(
+                    path=ctx.rel, line=child.lineno, rule=self.name,
+                    message=(
+                        "marker file written with a plain open(..., 'w') "
+                        "— route .done/.failed/.lease writes through the "
+                        "atomic _write_marker helpers "
+                        "(shard.scheduler/shard.queue), or a racing host "
+                        "reads a torn payload"
+                    ),
+                ))
+            self._scan(ctx, child, child_stack, findings)
+
+    def _is_marker_write(self, call: ast.Call) -> bool:
+        if not (isinstance(call.func, ast.Name)
+                and call.func.id == "open") or not call.args:
+            return False
+        mode = None
+        if len(call.args) > 1:
+            mode = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if not (isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)
+                and ("w" in mode.value or "x" in mode.value
+                     or "a" in mode.value)):
+            return False
+        target = ast.unparse(call.args[0])
+        return any(m in target for m in self.MARKERS)
