@@ -330,6 +330,7 @@ def assemble_result(
     e2e,                   # (px_steps_s, device_fraction, n_pixels)
     host_after_ms: float,
     fused_lin=None,        # (px_s, ms_median, ms_spread) or None (off-TPU)
+    serve=None,            # tools/loadgen rows dict or None
     n_matched: int = 16384,
     n_device: int = 1 << 19,
     registry=None,
@@ -409,6 +410,20 @@ def assemble_result(
         "e2e_pixel_steps_per_s": round(e2e_px_steps_s, 1),
         "e2e_device_fraction": round(device_frac, 3),
         "e2e_n_pixels": e2e_pix,
+        # Serving rows (tools/loadgen.py against the in-process
+        # assimilation service — warm-path request latency, BASELINE.md
+        # "Serving").  Gated by tools/bench_compare.py like the
+        # device_*_ms rows: disappearance or >10% regression fails.
+        "serve_p50_ms": None if serve is None
+        else serve.get("serve_p50_ms"),
+        "serve_p99_ms": None if serve is None
+        else serve.get("serve_p99_ms"),
+        "serve_cold_ms": None if serve is None
+        else serve.get("serve_cold_ms"),
+        "serve_rejected_total": None if serve is None
+        else serve.get("serve_rejected_total"),
+        "serve_requests_total": None if serve is None
+        else serve.get("serve_requests_total"),
         # Bench health layer (see telemetry.health.probe_health): off-band
         # probes flag the whole artifact so cross-round consumers discard
         # it instead of reading environment weather as a perf change.
@@ -484,6 +499,7 @@ def _bench_rows():
             file=sys.stderr,
         )
     e2e = bench_end_to_end()
+    serve = bench_serve_rows()
     host_after_ms = probe_host()
     print(json.dumps(assemble_result(
         health,
@@ -493,10 +509,42 @@ def _bench_rows():
         pallas=pallas,
         fused_lin=fused_lin,
         e2e=e2e,
+        serve=serve,
         host_after_ms=host_after_ms,
         n_matched=n_matched,
         n_device=n_device,
     )))
+
+
+def bench_serve_rows(requests: int = 24, concurrency: int = 4):
+    """The serving latency rows via tools/loadgen's self-contained
+    in-process harness (host-side orchestration — meaningful on CPU and
+    TPU alike).  Failure degrades to null rows with a loud stderr note
+    rather than killing the solve rows."""
+    import shutil
+    import tempfile
+
+    from tools.loadgen import bench_serve
+
+    tmp = tempfile.mkdtemp(prefix="kafka_bench_serve_")
+    try:
+        rows = bench_serve(tmp, requests=requests,
+                           concurrency=concurrency)
+        print(
+            f"serve: p50 {rows['serve_p50_ms']} ms, "
+            f"p99 {rows['serve_p99_ms']} ms over "
+            f"{rows['serve_ok_total']} ok / "
+            f"{rows['serve_requests_total']} requests "
+            f"(cold {rows['serve_cold_ms']} ms)",
+            file=sys.stderr,
+        )
+        return rows
+    except Exception as exc:  # degrade to null rows: the serving bench must never cost the solve rows
+        print(f"serve bench failed ({exc!r}) — serving rows null",
+              file=sys.stderr)
+        return None
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,176 @@
+"""``kafka-serve`` — the resident assimilation-as-a-service daemon.
+
+Serves observation-date requests against warm per-tile filter state
+(see BASELINE.md "Serving"): clients drop ``{"tile", "date"}`` JSON
+files into ``<root>/inbox/`` (atomic rename; ``serve.submit_request``
+does it for you) and read ``<root>/responses/<request_id>.json``.  A
+new observation date costs only the grid windows after the tile's
+newest checkpoint — an incremental predict/correct, not a full-series
+rerun.
+
+Robustness surface:
+
+- admission control + load shedding against the bounded queue and the
+  engine telemetry gauges (``--max-queue``, ``--max-writer-backlog``,
+  ``--shed-unhealthy``): overload answers fast rejections;
+- per-request deadlines (``--deadline-s``): expired requests are
+  cancelled and counted, never silently dropped;
+- SIGTERM = graceful drain (finish in-flight, reject new, exit 0);
+  SIGKILL = crash, recovered on restart by replaying ``requests.jsonl``
+  idempotently from the warm checkpoints;
+- chaos-scriptable via ``KAFKA_TPU_FAULTS`` at the ``serve.admit`` /
+  ``serve.solve`` / ``serve.respond`` fault points;
+- bounded telemetry for a long-lived process (events.jsonl rotation,
+  capped crash dumps).
+
+This driver serves SYNTHETIC tiles (the chaos/bench harness, like
+``run_synthetic``); production sources plug into the same
+``AssimilationService`` programmatically with real ``TileSpec``s.
+
+Usage:
+    kafka-serve --root /tmp/serve --tiles 2 --operator identity &
+    python -m tools.loadgen --root /tmp/serve --requests 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+from . import add_telemetry_arg, make_console
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", required=True,
+                    help="serve root (inbox/, responses/, requests.jsonl,"
+                         " ckpt_<tile>/ live here)")
+    ap.add_argument("--tiles", type=int, default=1,
+                    help="number of synthetic tiles to serve "
+                         "(tile0..tileN-1)")
+    ap.add_argument("--operator", default="identity",
+                    choices=("identity", "twostream", "wcm"))
+    ap.add_argument("--ny", type=int, default=20)
+    ap.add_argument("--nx", type=int, default=20)
+    ap.add_argument("--days", type=int, default=16)
+    ap.add_argument("--step", type=int, default=4,
+                    help="time-grid step in days")
+    ap.add_argument("--obs-every", type=int, default=2,
+                    help="observation cadence in days")
+    ap.add_argument("--scan-window", type=int, default=1,
+                    help="temporal fusion window (1 = unfused, the "
+                         "bit-exact serving configuration)")
+    ap.add_argument("--max-queue", type=int, default=16,
+                    help="admission bound on the request queue; beyond "
+                         "it requests are shed with reason queue_full")
+    ap.add_argument("--max-writer-backlog", type=int, default=256,
+                    help="shed when the async writer backlog gauge "
+                         "exceeds this (0 disables)")
+    ap.add_argument("--max-prefetch-depth", type=int, default=256,
+                    help="shed when the prefetch queue-depth gauge "
+                         "exceeds this (0 disables)")
+    ap.add_argument("--no-shed-unhealthy", action="store_true",
+                    help="keep admitting while the health probe verdict "
+                         "is off-band")
+    ap.add_argument("--deadline-s", type=float, default=None,
+                    help="default per-request wall-clock budget; "
+                         "expired requests are cancelled and counted")
+    ap.add_argument("--poll-interval-s", type=float, default=0.05,
+                    help="inbox scan cadence")
+    ap.add_argument("--exit-when-idle", action="store_true",
+                    help="exit 0 once the journal is replayed and the "
+                         "inbox/queue stay empty for --idle-grace-s "
+                         "(one-shot recovery / batch mode)")
+    ap.add_argument("--idle-grace-s", type=float, default=1.0)
+    ap.add_argument("--events-rotate-mb", type=float, default=32.0,
+                    help="rotate events.jsonl past this size "
+                         "(keep-N segments; a daemon cannot afford "
+                         "unbounded telemetry)")
+    ap.add_argument("--events-keep", type=int, default=3,
+                    help="rotated events.jsonl segments kept")
+    add_telemetry_arg(ap)
+    ap.add_argument("--verbose", action="store_true")
+    return ap
+
+
+def main(argv=None):
+    from ..utils.compilation_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING
+    )
+    from ..telemetry import (
+        configure, flight_recorder, get_registry,
+        install_compile_listeners, tracing,
+    )
+
+    install_compile_listeners()
+    if args.telemetry_dir:
+        configure(
+            args.telemetry_dir,
+            events_rotate_bytes=int(args.events_rotate_mb * 1024 * 1024),
+            events_keep=args.events_keep,
+        )
+    recorder = flight_recorder.install(args.telemetry_dir)
+    from ..resilience import faults
+    from ..serve import (
+        AdmissionPolicy, AssimilationService, ServeDaemon, TileSession,
+        make_synthetic_tile,
+    )
+
+    faults.install_from_env()
+    os.makedirs(args.root, exist_ok=True)
+    sessions = {}
+    for i in range(max(1, args.tiles)):
+        name = f"tile{i}"
+        spec = make_synthetic_tile(
+            name, ckpt_dir=os.path.join(args.root, f"ckpt_{name}"),
+            operator=args.operator, ny=args.ny, nx=args.nx,
+            days=args.days, step_days=args.step,
+            obs_every=args.obs_every, scan_window=args.scan_window,
+            seed=i,
+        )
+        sessions[name] = TileSession(spec)
+    policy = AdmissionPolicy(
+        max_queue_depth=args.max_queue,
+        max_prefetch_queue_depth=(
+            args.max_prefetch_depth if args.max_prefetch_depth > 0
+            else None
+        ),
+        max_writer_backlog=(
+            args.max_writer_backlog if args.max_writer_backlog > 0
+            else None
+        ),
+        shed_when_unhealthy=not args.no_shed_unhealthy,
+    )
+    service = AssimilationService(
+        sessions, args.root, policy=policy,
+        default_deadline_s=args.deadline_s,
+    )
+    daemon = ServeDaemon(
+        service, args.root,
+        poll_interval_s=args.poll_interval_s,
+        exit_when_idle=args.exit_when_idle,
+        idle_grace_s=args.idle_grace_s,
+    )
+    with tracing.push(run_id=tracing.new_run_id()), recorder:
+        summary = daemon.run()
+    reg = get_registry()
+    # Request-level errors completed the run but lost work — surface the
+    # partial-success exit code the other drivers use.
+    summary["failed"] = summary["errors"]
+    summary["telemetry_dir"] = reg.dump()
+    print(json.dumps(summary))
+    return summary
+
+
+console = make_console(main)
+
+
+if __name__ == "__main__":
+    sys.exit(console())

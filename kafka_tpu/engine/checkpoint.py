@@ -130,14 +130,19 @@ class Checkpointer:
             paths.append(path)
         return paths
 
-    def list_checkpoints(self) -> List[Tuple[datetime.datetime, List[str]]]:
-        """Timesteps with a COMPLETE shard set, oldest first.
+    def _scan_sets(self) -> List[Tuple[datetime.datetime,
+                                       Optional[List[str]], List[str]]]:
+        """All checkpoint timesteps oldest first, complete or not:
+        ``(ts, complete_paths | None, stray_paths)``.
 
         Shards are grouped by their ``of<total>`` declaration, so leftovers
         from a run with a different ``n_shards`` can never be mixed into a
         set (each file's shard count must agree).  If several totals have a
         complete set for one timestep (e.g. an old 2-shard and a finished
-        3-shard save), the most recently written set wins."""
+        3-shard save), the most recently written set wins.  ``stray_paths``
+        are the files of that timestep's INCOMPLETE totals — evidence of a
+        crash mid-save (or a concurrent save in flight) the loader must
+        treat as corrupt, never as a resumable state."""
         by_ts: dict = {}
         if not os.path.isdir(self.folder):
             return []
@@ -155,15 +160,25 @@ class Checkpointer:
         out = []
         for ts in sorted(by_ts):
             complete = []
+            strays: List[str] = []
             for total, shards in by_ts[ts].items():
                 if set(shards) == set(range(total)):
                     paths = [shards[k] for k in range(total)]
                     complete.append(
                         (max(os.path.getmtime(p) for p in paths), paths)
                     )
-            if complete:
-                out.append((ts, max(complete)[1]))
+                else:
+                    strays.extend(shards[k] for k in sorted(shards))
+            out.append(
+                (ts, max(complete)[1] if complete else None, strays)
+            )
         return out
+
+    def list_checkpoints(self) -> List[Tuple[datetime.datetime, List[str]]]:
+        """Timesteps with a COMPLETE shard set, oldest first (see
+        ``_scan_sets`` for the grouping rules)."""
+        return [(ts, paths) for ts, paths, _ in self._scan_sets()
+                if paths is not None]
 
     def load_latest(self, shard: Optional[int] = None,
                     ) -> Optional[Tuple[datetime.datetime, np.ndarray,
@@ -175,33 +190,43 @@ class Checkpointer:
         per-piece path for chunk-level restarts at scales where the
         assembled full matrix would not fit host RAM (the shards partition
         the pixel axis in order, ``np.linspace`` bounds as written)."""
-        ckpts = self.list_checkpoints()
-        # Newest first; an unreadable/truncated set (crash mid-save
-        # pre-dating the atomic writer, torn filesystem, bit rot) is
-        # skipped with a logged event and the previous intact set wins —
-        # resuming slightly earlier beats dying on a corrupt file.
-        for ts, paths in reversed(ckpts):
+        # Newest first; a corrupt set — an unreadable/truncated shard
+        # (crash mid-save pre-dating the atomic writer, torn filesystem,
+        # bit rot), a MISSING shard (crash between shard writes), or
+        # shards whose shapes disagree — is skipped with a logged event
+        # and the previous intact set wins: resuming slightly earlier
+        # beats dying on a corrupt file.
+        for ts, paths, strays in reversed(self._scan_sets()):
+            if paths is None:
+                self._note_unreadable(
+                    ts, strays,
+                    "incomplete shard set (missing shard files)",
+                )
+                continue
             use = [paths[shard]] if shard is not None else paths
             try:
                 x, p_inv = self._load_set(use)
             except _UNREADABLE_ERRORS as exc:
-                LOG.warning(
-                    "checkpoint %s is unreadable (%r); falling back to "
-                    "the previous intact checkpoint", ts, exc,
-                )
-                get_registry().counter(
-                    "kafka_checkpoint_unreadable_total",
-                    "checkpoint sets skipped by load_latest because a "
-                    "file was truncated/corrupt",
-                ).inc()
-                get_registry().emit(
-                    "checkpoint_unreadable", timestep=str(ts),
-                    paths=[os.path.basename(q) for q in use],
-                    error=repr(exc)[:300],
-                )
+                self._note_unreadable(ts, use, repr(exc)[:300])
                 continue
             return ts, x, p_inv
         return None
+
+    def _note_unreadable(self, ts, paths: List[str], error: str) -> None:
+        LOG.warning(
+            "checkpoint %s is unusable (%s); falling back to the "
+            "previous intact checkpoint", ts, error,
+        )
+        get_registry().counter(
+            "kafka_checkpoint_unreadable_total",
+            "checkpoint sets skipped by load_latest because a file was "
+            "truncated/corrupt or a shard was missing",
+        ).inc()
+        get_registry().emit(
+            "checkpoint_unreadable", timestep=str(ts),
+            paths=[os.path.basename(q) for q in paths],
+            error=error,
+        )
 
     @staticmethod
     def _load_set(paths: List[str]):
@@ -217,6 +242,16 @@ class Checkpointer:
                 if full.size:
                     p = full.shape[-1]
                     trils.append(pack_tril(full))
+        # Cross-shard consistency: shards written by different runs (or a
+        # torn rewrite under a different state layout) must read as
+        # corrupt, not silently concatenate into a wrong-shaped state.
+        if len({a.shape[-1] for a in xs if a.ndim > 1}) > 1 or \
+                len({t.shape[-1] for t in trils}) > 1:
+            raise ValueError(
+                "checkpoint shards disagree on the state/information "
+                f"width: {[a.shape for a in xs]} / "
+                f"{[t.shape for t in trils]}"
+            )
         x = np.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
         if p == 0:
             return x, None

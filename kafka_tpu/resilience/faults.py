@@ -29,6 +29,16 @@ In-repo sites:
                         double-execution path)
 ``checkpoint.save``     one checkpoint shard write in
                         ``engine.checkpoint.Checkpointer.save``
+``serve.admit``         one admission decision in
+                        ``serve.service.AssimilationService.submit``
+                        (an injected fault here sheds the request —
+                        counted rejection, never a crashed daemon)
+``serve.solve``         one request's incremental solve on the serving
+                        worker (transient retries under the service
+                        retry policy; poison answers an error response)
+``serve.respond``       one atomic response write (a crash between
+                        solve and respond is exactly what the request
+                        journal's idempotent replay recovers)
 ================== ====================================================
 
 Scripting from tests::

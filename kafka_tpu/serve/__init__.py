@@ -1,0 +1,34 @@
+"""Assimilation-as-a-service: the crash-safe warm-state serving layer.
+
+All six CLI drivers are batch one-shots; this package is the resident
+front end the ROADMAP's "millions of users" item calls for — request
+queue -> admission control -> incremental warm-state solve -> result
+cache, exposed by the ``kafka-serve`` daemon (``cli.kafka_serve``) and
+measured by ``tools/loadgen.py``.  See BASELINE.md "Serving".
+"""
+
+from .admission import AdmissionController, AdmissionPolicy
+from .daemon import ServeDaemon, read_response, submit_request
+from .journal import RequestJournal
+from .request import BadRequest, ServeRequest, parse_request
+from .service import AssimilationService
+from .session import TileSession, TileSpec, UnknownDateError
+from .synthetic import make_synthetic_tile, synthetic_dates
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionPolicy",
+    "AssimilationService",
+    "BadRequest",
+    "RequestJournal",
+    "ServeDaemon",
+    "ServeRequest",
+    "TileSession",
+    "TileSpec",
+    "UnknownDateError",
+    "make_synthetic_tile",
+    "parse_request",
+    "read_response",
+    "submit_request",
+    "synthetic_dates",
+]

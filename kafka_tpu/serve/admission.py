@@ -1,0 +1,86 @@
+"""Admission control and load shedding for the serving daemon.
+
+Overload must degrade to FAST REJECTION, not queue collapse: a queue
+that admits everything turns a 2x overload into unbounded latency for
+every request (and unbounded host memory), while a bounded queue plus
+cheap up-front rejection keeps the admitted requests' latency flat and
+gives the shed requests an immediate, explicit answer they can retry
+against another replica.
+
+The controller reads the PR 2/3 telemetry gauges as its load signals —
+the SAME single-source-of-truth registry the bench health layer and the
+Prometheus export read:
+
+=============================== =====================================
+``kafka_serve_queue_depth``     requests admitted but not yet served
+                                (the primary signal; compared against
+                                ``max_queue_depth``)
+``kafka_prefetch_queue_depth``  prefetched-but-unconsumed observation
+                                dates (host memory held by the input
+                                pipeline)
+``kafka_io_writer_backlog``     queued async GeoTIFF writes (host
+                                memory + disk pressure on the output
+                                side)
+``kafka_health_unhealthy``      the latest ``probe_health`` verdict —
+                                an off-band host serves garbage
+                                latency, so shedding beats queueing
+=============================== =====================================
+
+Every decision is explicit: admitted requests count into
+``kafka_serve_admitted_total``, shed requests into
+``kafka_serve_rejected_total`` labelled by reason — overload is an
+operator-visible number, never a silent drop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..telemetry import get_registry
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionPolicy:
+    """The rejected-vs-queued contract, as data.
+
+    ``max_queue_depth`` bounds the service's own request queue (the
+    explicit queue-or-reject line).  The two pipeline bounds shed load
+    when the engine's host-side buffers back up; ``None`` disables a
+    signal.  ``shed_when_unhealthy`` rejects while the latest health
+    probe verdict is off-band.
+    """
+
+    max_queue_depth: int = 16
+    max_prefetch_queue_depth: Optional[int] = 256
+    max_writer_backlog: Optional[int] = 256
+    shed_when_unhealthy: bool = True
+
+
+class AdmissionController:
+    """Decides admit-vs-shed for one request; stateless between calls
+    (all state lives in the telemetry registry it reads)."""
+
+    def __init__(self, policy: Optional[AdmissionPolicy] = None):
+        self.policy = policy or AdmissionPolicy()
+
+    def decide(self, queue_depth: int) -> Optional[str]:
+        """``None`` to admit, else the rejection reason (a short token
+        that labels ``kafka_serve_rejected_total``)."""
+        pol = self.policy
+        if queue_depth >= pol.max_queue_depth:
+            return "queue_full"
+        reg = get_registry()
+        if pol.max_prefetch_queue_depth is not None:
+            depth = reg.value("kafka_prefetch_queue_depth")
+            if depth is not None and depth > pol.max_prefetch_queue_depth:
+                return "prefetch_backlog"
+        if pol.max_writer_backlog is not None:
+            backlog = reg.value("kafka_io_writer_backlog")
+            if backlog is not None and backlog > pol.max_writer_backlog:
+                return "writer_backlog"
+        if pol.shed_when_unhealthy:
+            unhealthy = reg.value("kafka_health_unhealthy")
+            if unhealthy:
+                return "unhealthy"
+        return None

@@ -1,0 +1,128 @@
+"""Crash-safe request journal + atomic response store.
+
+The daemon's durable memory is two filesystem structures under its
+serve root:
+
+``requests.jsonl``
+    append-only journal: every ADMITTED request is recorded before it
+    enters the work queue (rejected requests are answered, not
+    journaled — there is nothing to recover).  One JSON object per
+    line; a torn final line (crash mid-append) is skipped with an
+    event, never a crashed restart.
+
+``responses/<request_id>.json``
+    one atomic file per answered request (unique tmp + ``os.replace``,
+    the marker-write discipline of the chunk queue) — the client-visible
+    result AND the journal's completion marker.
+
+**Replay.**  On restart, every journaled request with no response file
+is re-enqueued in submission order.  Serving is deterministic and the
+response write is atomic, so replay is idempotent: a request that
+crashed after its solve but before its respond simply re-runs from the
+warm checkpoint and overwrites nothing (its response did not exist);
+a request that crashed mid-response-write left only a tmp file, which
+is ignored.  Duplicate journal lines (same id) replay once.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+from typing import Dict, List, Optional
+
+from ..telemetry import get_registry
+
+LOG = logging.getLogger(__name__)
+
+JOURNAL_NAME = "requests.jsonl"
+RESPONSES_DIR = "responses"
+
+#: per-process unique response tmp names (pid + counter), same twin as
+#: the scheduler/checkpoint atomic writers.
+_TMP_COUNTER = itertools.count()
+
+
+class RequestJournal:
+    """One serve root's journal + response store."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.journal_path = os.path.join(root, JOURNAL_NAME)
+        self.responses_dir = os.path.join(root, RESPONSES_DIR)
+        os.makedirs(self.responses_dir, exist_ok=True)
+        self._fh = open(self.journal_path, "a", buffering=1)
+
+    # -- journal --------------------------------------------------------
+
+    def record(self, payload: dict) -> None:
+        """Append one admitted request; flushed + fsynced so an admitted
+        request survives a crash that follows immediately."""
+        line = json.dumps(payload, default=str) + "\n"
+        self._fh.write(line)
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def replay(self) -> List[dict]:
+        """Journaled request payloads with no response, oldest first."""
+        if not os.path.exists(self.journal_path):
+            return []
+        seen: Dict[str, dict] = {}
+        with open(self.journal_path) as f:
+            for lineno, line in enumerate(f, start=1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    payload = json.loads(line)
+                except ValueError:
+                    # A torn tail is the signature of a crash mid-append;
+                    # the work it described was never acked as queued.
+                    get_registry().emit(
+                        "journal_torn_line", line_no=lineno,
+                        path=self.journal_path,
+                    )
+                    LOG.warning(
+                        "skipping torn journal line %d in %s",
+                        lineno, self.journal_path,
+                    )
+                    continue
+                rid = payload.get("request_id")
+                if isinstance(rid, str) and rid not in seen:
+                    seen[rid] = payload
+        return [p for rid, p in seen.items()
+                if not os.path.exists(self.response_path(rid))]
+
+    # -- responses ------------------------------------------------------
+
+    def response_path(self, request_id: str) -> str:
+        return os.path.join(self.responses_dir, f"{request_id}.json")
+
+    def respond(self, request_id: str, payload: dict) -> str:
+        """Atomically publish one response (unique tmp + os.replace —
+        a reader can never observe a torn response)."""
+        path = self.response_path(request_id)
+        tmp = f"{path}.tmp.{os.getpid()}.{next(_TMP_COUNTER)}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+
+    def response(self, request_id: str) -> Optional[dict]:
+        try:
+            with open(self.response_path(request_id)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError):
+            # Unreadable response = no response; replay will re-serve.
+            return None
+
+    def close(self) -> None:
+        try:
+            self._fh.close()
+        except OSError:  # already closed / torn down — nothing held
+            pass

@@ -1,0 +1,425 @@
+"""The assimilation service: queue -> admission -> solve -> respond.
+
+The in-process heart of the serving daemon (``serve.daemon`` wraps it in
+a filesystem transport; tests and ``tools/loadgen.py`` drive it
+directly).  Robustness is the design surface:
+
+- **Admission first** (``serve.admission``): every submission is decided
+  admit-or-shed BEFORE any work happens, against the bounded queue and
+  the engine's telemetry gauges.  Shed requests get an immediate
+  ``rejected`` response and a counted reason — overload degrades to fast
+  rejection, never to queue collapse.
+- **Journal before queue** (``serve.journal``): an admitted request is
+  durable before it is acked, so a crash at ANY later point is
+  recoverable by idempotent replay.
+- **Deadlines** (``resilience.policy.Deadline``): a request whose
+  wall-clock budget expired before its turn is CANCELLED — counted and
+  answered, never silently dropped.
+- **Classified failures**: a poison solve answers an ``error`` response
+  (the daemon survives bad requests); transient solve/respond failures
+  retry under a ``RetryPolicy``; fatal ones kill the process into the
+  flight recorder, and the journal replays the in-flight request on
+  restart.
+- **Chaos hooks**: ``serve.admit`` / ``serve.solve`` / ``serve.respond``
+  fault points make the shed, cancel, error and crash-resume paths
+  scriptable deterministically on CPU (``KAFKA_TPU_FAULTS``).
+- **Drain**: ``drain()`` (the daemon's SIGTERM) finishes in-flight and
+  queued work, rejects new submissions with reason ``draining``, and
+  returns with every admitted request answered; tile state is already
+  durable because every serve ends in a checkpoint.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+from ..resilience import (
+    FATAL,
+    DeadlineExceeded,
+    RetryPolicy,
+    classify_failure,
+    faults,
+)
+from ..telemetry import get_registry, tracing
+from .admission import AdmissionController, AdmissionPolicy
+from .journal import RequestJournal
+from .request import BadRequest, ServeRequest, parse_request
+from .session import TileSession
+
+LOG = logging.getLogger(__name__)
+
+#: solve/respond retry default: one in-place retry of transient weather,
+#: short deterministic backoff — a serving worker must not sit in long
+#: backoff while the queue builds behind it.
+DEFAULT_SERVE_RETRY = RetryPolicy(
+    max_attempts=2, base_delay=0.1, multiplier=2.0, max_delay=1.0,
+    jitter=0.0,
+)
+
+
+def _serve_metrics(reg):
+    """Single registration site for the service's metric vocabulary."""
+    return {
+        "admitted": reg.counter(
+            "kafka_serve_admitted_total",
+            "requests accepted into the serve queue",
+        ),
+        "rejected": reg.counter(
+            "kafka_serve_rejected_total",
+            "requests shed at admission, labelled by reason — overload "
+            "degrades to fast rejection, never silent queue collapse",
+        ),
+        "cancelled": reg.counter(
+            "kafka_serve_cancelled_total",
+            "admitted requests cancelled because their per-request "
+            "deadline expired before serving",
+        ),
+        "errors": reg.counter(
+            "kafka_serve_errors_total",
+            "admitted requests answered with an error response "
+            "(poison solves; the daemon itself survives)",
+        ),
+        "cache_hits": reg.counter(
+            "kafka_serve_cache_hits_total",
+            "requests answered from the in-memory result cache",
+        ),
+        "replayed": reg.counter(
+            "kafka_serve_replayed_total",
+            "journaled requests re-enqueued by crash-recovery replay",
+        ),
+        "respond_errors": reg.counter(
+            "kafka_serve_respond_errors_total",
+            "responses that could not be written after retries (the "
+            "journal replays the request on restart)",
+        ),
+        "depth": reg.gauge(
+            "kafka_serve_queue_depth",
+            "requests admitted but not yet served (the admission "
+            "controller's primary load signal)",
+        ),
+        "latency": reg.histogram(
+            "kafka_serve_latency_seconds",
+            "submit-to-response seconds for OK-served requests",
+        ),
+    }
+
+
+class AssimilationService:
+    """Long-lived serving core over a set of warm tile sessions."""
+
+    def __init__(
+        self,
+        sessions: Dict[str, TileSession],
+        root: str,
+        policy: Optional[AdmissionPolicy] = None,
+        default_deadline_s: Optional[float] = None,
+        retry_policy: Optional[RetryPolicy] = None,
+        result_cache_size: int = 256,
+    ):
+        self.sessions = dict(sessions)
+        self.journal = RequestJournal(root)
+        self.admission = AdmissionController(policy)
+        self.default_deadline_s = default_deadline_s
+        self._retry = retry_policy if retry_policy is not None \
+            else DEFAULT_SERVE_RETRY
+        self._cache: "collections.OrderedDict" = collections.OrderedDict()
+        self._cache_size = int(result_cache_size)
+        self._queue: "collections.deque[ServeRequest]" = collections.deque()
+        self._cond = threading.Condition()
+        self._responded = threading.Condition()
+        self._draining = threading.Event()
+        self._stopped = threading.Event()
+        self._busy = False
+        reg = get_registry()
+        self._m = _serve_metrics(reg)
+        # PR 3 thread-tracing convention: capture the constructing
+        # thread's context, re-install it on the worker.
+        self._ctx = tracing.current_context()
+        self._worker = threading.Thread(
+            target=self._run, name="serve-worker", daemon=True,
+        )
+        self._started = False
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "AssimilationService":
+        """Replay the journal, then start the serving worker."""
+        if self._started:
+            return self
+        replayed = self.journal.replay()
+        for payload in replayed:
+            try:
+                req = parse_request(payload, replayed=True)
+            except BadRequest:
+                # A journaled line that no longer parses is forensic
+                # residue, not recoverable work.
+                get_registry().emit(
+                    "request_unreplayable",
+                    request_id=str(payload.get("request_id")),
+                )
+                continue
+            if req.tile not in self.sessions:
+                get_registry().emit(
+                    "request_unreplayable", request_id=req.request_id,
+                    reason=f"unknown tile {req.tile}",
+                )
+                continue
+            self._m["replayed"].inc()
+            get_registry().emit(
+                "request_replayed", request_id=req.request_id,
+                tile=req.tile, date=req.date.isoformat(),
+            )
+            with self._cond:
+                self._queue.append(req)
+        self._set_depth()
+        self._started = True
+        self._worker.start()
+        with self._cond:
+            self._cond.notify_all()
+        return self
+
+    def close(self) -> None:
+        """Stop the worker (after the queue drains) and release files."""
+        self._stopped.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._started:
+            self._worker.join(timeout=60.0)
+        self.journal.close()
+
+    def stop_admitting(self) -> None:
+        """Flip new submissions to ``rejected: draining`` immediately
+        (the drain's first half, split out so the daemon can answer
+        latecomers with explicit rejections before the final wait)."""
+        self._draining.set()
+
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """SIGTERM semantics: reject new work, finish everything already
+        admitted.  Returns True when the queue fully drained."""
+        if not self._draining.is_set():
+            self._draining.set()
+            get_registry().emit("serve_drain")
+        deadline = None if timeout_s is None \
+            else time.monotonic() + timeout_s
+        with self._cond:
+            while self._queue or self._busy:
+                wait = None if deadline is None \
+                    else max(0.0, deadline - time.monotonic())
+                if wait is not None and wait <= 0:
+                    return False
+                self._cond.wait(timeout=wait if wait is not None else 1.0)
+        return True
+
+    def pending(self) -> int:
+        with self._cond:
+            return len(self._queue) + (1 if self._busy else 0)
+
+    # -- submission -----------------------------------------------------
+
+    def submit(self, payload: dict) -> dict:
+        """Admit-or-shed one raw request payload.  Returns the ack:
+        ``{"request_id", "status": "queued"|"rejected", ...}``.  Every
+        rejection also lands as a response file so cross-process clients
+        see it."""
+        rid = payload.get("request_id") if isinstance(payload, dict) \
+            else None
+        try:
+            faults.fault_point("serve.admit", request=str(rid))
+            req = parse_request(
+                payload, default_deadline_s=self.default_deadline_s,
+            )
+        except BaseException as exc:
+            if classify_failure(exc) == FATAL:
+                raise
+            reason = "bad_request" if isinstance(exc, BadRequest) \
+                else "admit_error"
+            return self._reject(rid, reason, detail=repr(exc)[:200])
+        if req.tile not in self.sessions:
+            return self._reject(req.request_id, "unknown_tile")
+        if self._draining.is_set() or self._stopped.is_set():
+            return self._reject(req.request_id, "draining")
+        with self._cond:
+            reason = self.admission.decide(queue_depth=len(self._queue))
+            if reason is None:
+                self.journal.record(req.payload())
+                self._queue.append(req)
+                self._m["admitted"].inc()
+                self._set_depth_locked()
+                self._cond.notify_all()
+        if reason is not None:
+            return self._reject(req.request_id, reason)
+        get_registry().emit(
+            "request_admitted", request_id=req.request_id,
+            tile=req.tile, date=req.date.isoformat(),
+        )
+        return {"request_id": req.request_id, "status": "queued"}
+
+    def _reject(self, request_id: Optional[str], reason: str,
+                detail: Optional[str] = None) -> dict:
+        self._m["rejected"].inc(reason=reason)
+        get_registry().emit(
+            "request_rejected", request_id=str(request_id), reason=reason,
+        )
+        ack = {"request_id": request_id, "status": "rejected",
+               "reason": reason}
+        if detail:
+            ack["detail"] = detail
+        if request_id and isinstance(request_id, str):
+            # Best-effort: the rejection must reach cross-process
+            # clients, but a full disk must not crash admission.
+            try:
+                self._publish(request_id, ack)
+            except OSError as exc:
+                LOG.warning("could not write rejection response for %s: "
+                            "%r", request_id, exc)
+        return ack
+
+    # -- results --------------------------------------------------------
+
+    def result(self, request_id: str,
+               timeout_s: Optional[float] = None) -> Optional[dict]:
+        """Block until ``request_id`` has a response (or timeout)."""
+        deadline = None if timeout_s is None \
+            else time.monotonic() + timeout_s
+        with self._responded:
+            while True:
+                got = self.journal.response(request_id)
+                if got is not None:
+                    return got
+                wait = 1.0 if deadline is None \
+                    else deadline - time.monotonic()
+                if wait <= 0:
+                    return None
+                self._responded.wait(timeout=min(wait, 1.0))
+
+    # -- the worker loop ------------------------------------------------
+
+    def _run(self) -> None:
+        tracing.set_context(self._ctx)
+        tracing.set_lane("serve")
+        while True:
+            with self._cond:
+                while not self._queue and not self._stopped.is_set():
+                    self._cond.wait(timeout=0.5)
+                if not self._queue and self._stopped.is_set():
+                    return
+                req = self._queue.popleft()
+                self._busy = True
+                self._set_depth_locked()
+            try:
+                self._process(req)
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
+
+    def _process(self, req: ServeRequest) -> None:
+        reg = get_registry()
+        key = (req.tile, req.date.isoformat())
+        try:
+            if req.deadline is not None:
+                req.deadline.check(f"request {req.request_id}")
+        except DeadlineExceeded as exc:
+            self._m["cancelled"].inc()
+            reg.emit(
+                "request_cancelled", request_id=req.request_id,
+                tile=req.tile, date=req.date.isoformat(),
+                waited_s=round(time.time() - req.submitted_ts, 3),
+            )
+            self._respond(req, {
+                "status": "cancelled", "reason": "deadline",
+                "detail": str(exc), "tile": req.tile,
+                "date": req.date.isoformat(),
+            })
+            return
+        cached = self._cache.get(key)
+        if cached is not None:
+            self._m["cache_hits"].inc()
+            body = dict(cached)
+            body["served_from"] = "cache"
+            self._finish_ok(req, body)
+            return
+
+        def solve():
+            faults.fault_point(
+                "serve.solve", request=req.request_id, tile=req.tile,
+            )
+            return self.sessions[req.tile].serve(req.date)
+
+        try:
+            with tracing.push(window_id=req.request_id):
+                body = self._retry.call(solve, site="serve.solve")
+        except BaseException as exc:
+            if classify_failure(exc) == FATAL:
+                raise
+            self._m["errors"].inc()
+            reg.emit(
+                "request_error", request_id=req.request_id,
+                tile=req.tile, date=req.date.isoformat(),
+                error=repr(exc)[:300],
+            )
+            self._respond(req, {
+                "status": "error", "error": repr(exc)[:300],
+                "tile": req.tile, "date": req.date.isoformat(),
+            })
+            return
+        self._cache[key] = body
+        self._cache.move_to_end(key)
+        while len(self._cache) > self._cache_size:
+            self._cache.popitem(last=False)
+        self._finish_ok(req, body)
+
+    def _finish_ok(self, req: ServeRequest, body: dict) -> None:
+        latency = time.time() - req.submitted_ts
+        body = dict(body)
+        body["request_id"] = req.request_id
+        body["latency_ms"] = round(latency * 1e3, 3)
+        if not req.replayed:
+            self._m["latency"].observe(latency)
+        get_registry().emit(
+            "request_done", request_id=req.request_id, tile=req.tile,
+            date=req.date.isoformat(),
+            served_from=body.get("served_from"),
+            latency_ms=body["latency_ms"],
+        )
+        self._respond(req, body)
+
+    def _respond(self, req: ServeRequest, body: dict) -> None:
+        body.setdefault("request_id", req.request_id)
+
+        def write():
+            faults.fault_point("serve.respond", request=req.request_id)
+            return self._publish(req.request_id, body)
+
+        try:
+            self._retry.call(write, site="serve.respond")
+        except BaseException as exc:
+            if classify_failure(exc) == FATAL:
+                raise
+            # The solve's effects are durable (checkpoints); only the
+            # answer is lost.  Counted + logged — and because no
+            # response file exists, a restart's replay re-serves it.
+            self._m["respond_errors"].inc()
+            get_registry().emit(
+                "respond_failed", request_id=req.request_id,
+                error=repr(exc)[:300],
+            )
+            LOG.error("response write for %s failed: %r",
+                      req.request_id, exc)
+
+    def _publish(self, request_id: str, body: dict) -> str:
+        path = self.journal.respond(request_id, body)
+        with self._responded:
+            self._responded.notify_all()
+        return path
+
+    def _set_depth(self) -> None:
+        with self._cond:
+            self._set_depth_locked()
+
+    def _set_depth_locked(self) -> None:
+        self._m["depth"].set(len(self._queue))

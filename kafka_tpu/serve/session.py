@@ -1,0 +1,196 @@
+"""Warm per-tile filter state and the incremental serve path.
+
+The Kalman structure (PAPER.md §propagation) makes serving a NEW
+observation date from warm state near-free: the analysis at the last
+grid step is a sufficient statistic for everything before it, so a
+request only needs the predict/correct steps AFTER the newest
+checkpoint — not a full-series rerun.  A :class:`TileSession` holds one
+tile's serving state with the CHECKPOINT SET as the canonical store
+(``engine.checkpoint.Checkpointer``): every serve resumes from
+``load_latest`` + ``resume_time_grid`` and re-checkpoints at its end.
+Routing state through the checkpoint (rather than a process-local
+array) is what makes a SIGKILLed daemon and an uninterrupted one
+indistinguishable — both read the same durable bytes — and it is why
+the warm-path parity test can demand the incremental result be
+identical to a cold full-series rerun.
+
+Serve outcomes (the response's ``served_from`` field):
+
+``cold``
+    no usable checkpoint — full-series run from the tile prior,
+    checkpointing as it goes (the first request pays this once).
+``warm``
+    resumed from the newest intact checkpoint; only the grid windows
+    after it ran.
+``warm_noop``
+    the newest checkpoint already sits AT the requested grid step —
+    the state is read back and answered with zero solve work (the
+    ``resume_time_grid`` empty-remainder invariant).
+``cold_replay``
+    the request is BEHIND the warm state (a date the warm chain has
+    passed).  Served by a throwaway full run up to that date with NO
+    checkpointing, so historical reads never rewind the warm chain.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import hashlib
+import logging
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ..engine.checkpoint import Checkpointer
+from ..telemetry import get_registry, span
+
+LOG = logging.getLogger(__name__)
+
+
+class UnknownDateError(ValueError):
+    """A requested date the tile's observation source does not carry.
+    Poison-classed: retrying cannot make the date exist."""
+
+    kafka_failure_class = "poison"
+
+
+@dataclasses.dataclass
+class TileSpec:
+    """Everything needed to (re)build one tile's filter.
+
+    ``make_filter()`` returns ``(kf, x0, p_inv0, output)`` — a FRESH
+    ``KalmanFilter`` with its observation source and output writer, plus
+    the tile prior's initial state.  It is called once per serve: filter
+    objects are cheap, the expensive jitted programs are cached
+    process-wide by operator identity, and a fresh prefetcher per run is
+    the engine's existing lifecycle.
+    """
+
+    name: str
+    make_filter: Callable[[], tuple]
+    base_date: datetime.datetime
+    step_days: int
+    ckpt_dir: str
+    n_shards: int = 1
+
+    def grid_through(self, date: datetime.datetime) -> List[datetime.datetime]:
+        """The tile's canonical time grid extended just past ``date``
+        (windows are half-open ``[t_{k-1}, t_k)``, so the last grid
+        point must be strictly after the requested observation)."""
+        if date < self.base_date:
+            raise UnknownDateError(
+                f"{date} predates tile base {self.base_date}"
+            )
+        grid = [self.base_date]
+        step = datetime.timedelta(days=self.step_days)
+        while grid[-1] <= date:
+            grid.append(grid[-1] + step)
+        return grid
+
+
+class TileSession:
+    """One tile's serving state; NOT thread-safe (the service serializes
+    serves on its worker thread)."""
+
+    def __init__(self, spec: TileSpec):
+        self.spec = spec
+        self.name = spec.name
+        self.checkpointer = Checkpointer(
+            spec.ckpt_dir, n_shards=spec.n_shards
+        )
+        #: the last serve's final (x, p_inv) as host arrays — test and
+        #: diagnostics access; the durable state is the checkpoint set.
+        self.last_state: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self.serves = 0
+
+    # -- the serve path -------------------------------------------------
+
+    def serve(self, date: datetime.datetime) -> dict:
+        """Answer one observation-date request; returns the response
+        body (status/served_from/summary fields, JSON-serialisable)."""
+        t0 = time.perf_counter()
+        kf, x0, p_inv0, output = self.spec.make_filter()
+        try:
+            if date not in set(kf.observations.dates):
+                raise UnknownDateError(
+                    f"tile {self.name} has no observation on {date}"
+                )
+            grid = self.spec.grid_through(date)
+            resumed, seed = self.checkpointer.resume_time_grid(grid)
+            if seed is None:
+                served_from = "cold"
+                windows_run = len(grid) - 1
+                with span("serve_solve"):
+                    x, _, p_inv = kf.run(
+                        grid, x0, None, p_inv0,
+                        checkpointer=self.checkpointer,
+                    )
+            elif len(resumed) == 1 and resumed[0] == grid[-1]:
+                # Empty remainder: the checkpoint IS the answer.
+                served_from = "warm_noop"
+                windows_run = 0
+                x, p_inv = seed
+            elif resumed[0] > grid[-1]:
+                # The warm chain moved past this date; replay history
+                # without touching the chain's checkpoints.
+                served_from = "cold_replay"
+                windows_run = len(grid) - 1
+                with span("serve_solve"):
+                    x, _, p_inv = kf.run(
+                        grid, x0, None, p_inv0, checkpointer=None,
+                    )
+            else:
+                served_from = "warm"
+                windows_run = len(resumed) - 1
+                x_r, p_inv_r = seed
+                with span("serve_solve"):
+                    x, _, p_inv = kf.run(
+                        resumed, x_r, None, p_inv_r,
+                        checkpointer=self.checkpointer,
+                        advance_first=True,
+                    )
+        finally:
+            close = getattr(output, "close", None)
+            if close is not None:
+                close()
+        x_np = np.asarray(x, np.float32)
+        n_valid = kf.gather.n_valid
+        x_valid = np.ascontiguousarray(x_np[:n_valid])
+        if served_from in ("cold", "warm"):
+            self.last_state = (x_np, None if p_inv is None
+                               else np.asarray(p_inv, np.float32))
+        self.serves += 1
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        self._record(served_from, windows_run, wall_ms)
+        return {
+            "status": "ok",
+            "tile": self.name,
+            "date": date.isoformat(),
+            "served_from": served_from,
+            "windows_run": windows_run,
+            "n_pixels": int(n_valid),
+            "x_mean": [round(float(v), 7)
+                       for v in x_valid.mean(axis=0)],
+            "x_sha256": hashlib.sha256(x_valid.tobytes()).hexdigest(),
+            "wall_ms": round(wall_ms, 3),
+        }
+
+    def _record(self, served_from: str, windows_run: int,
+                wall_ms: float) -> None:
+        reg = get_registry()
+        reg.counter(
+            "kafka_serve_solves_total",
+            "tile serves by path (cold / warm / warm_noop / cold_replay)",
+        ).inc(served_from=served_from)
+        reg.counter(
+            "kafka_serve_windows_run_total",
+            "grid windows actually executed by serves — the warm path's "
+            "win is this number staying near the per-request delta "
+            "instead of the full series length",
+        ).inc(windows_run)
+        reg.emit(
+            "serve_solved", tile=self.name, served_from=served_from,
+            windows_run=windows_run, wall_ms=round(wall_ms, 3),
+        )

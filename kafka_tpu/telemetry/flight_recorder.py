@@ -48,6 +48,13 @@ class FlightRecorder:
     #: events kept in a dump (the registry ring may hold more).
     MAX_DUMP_EVENTS = 256
 
+    #: accumulated ``crash_*.json`` files kept in the destination
+    #: directory — after each dump the oldest beyond this cap are
+    #: removed, so a long-lived process that keeps hitting (and
+    #: surviving) unhealthy-probe or per-request crash dumps cannot fill
+    #: the disk.  The filename's timestamp prefix sorts chronologically.
+    MAX_CRASH_DUMPS = 16
+
     def __init__(self, directory: Optional[str] = None):
         self.directory = directory
         self._lock = threading.Lock()
@@ -111,7 +118,20 @@ class FlightRecorder:
             except OSError:
                 pass
             reg.emit("crash_dump", reason=reason, path=path)
+            self._prune_dumps(directory)
         return path
+
+    def _prune_dumps(self, directory: str) -> None:
+        """Drop the oldest ``crash_*.json`` beyond MAX_CRASH_DUMPS."""
+        try:
+            dumps = sorted(
+                n for n in os.listdir(directory)
+                if n.startswith("crash_") and n.endswith(".json")
+            )
+            for name in dumps[:-self.MAX_CRASH_DUMPS or None]:
+                os.unlink(os.path.join(directory, name))
+        except OSError:
+            pass  # pruning is hygiene; the dump above is the artifact
 
     @staticmethod
     def _thread_snapshot() -> list:

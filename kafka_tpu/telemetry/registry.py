@@ -139,8 +139,18 @@ class MetricsRegistry:
     ring only) so instrumented code needs no "is telemetry on" branches.
     """
 
+    #: events.jsonl rotation defaults: segments are size-capped and only
+    #: the newest ``keep`` rotated segments survive, so a LONG-LIVED
+    #: process (the serving daemon) cannot grow its telemetry without
+    #: bound.  Batch runs never reach the cap, so their behaviour is
+    #: unchanged.
+    EVENTS_ROTATE_BYTES = 32 * 1024 * 1024
+    EVENTS_KEEP = 3
+
     def __init__(self, directory: Optional[str] = None,
-                 max_events: int = 4096):
+                 max_events: int = 4096,
+                 events_rotate_bytes: Optional[int] = None,
+                 events_keep: Optional[int] = None):
         self._lock = threading.Lock()
         self._metrics: Dict[str, _Metric] = {}
         self.directory = directory
@@ -151,11 +161,22 @@ class MetricsRegistry:
         #: as Chrome trace-event JSON by dump().  See telemetry.tracing.
         self.trace = TraceBuffer()
         self._events_fh = None
+        self._events_rotate_bytes = (
+            events_rotate_bytes if events_rotate_bytes is not None
+            else self.EVENTS_ROTATE_BYTES
+        )
+        self._events_keep = (
+            events_keep if events_keep is not None else self.EVENTS_KEEP
+        )
+        self._events_bytes = 0
         if directory:
             os.makedirs(directory, exist_ok=True)
-            self._events_fh = open(
-                os.path.join(directory, "events.jsonl"), "a", buffering=1
-            )
+            path = os.path.join(directory, "events.jsonl")
+            try:
+                self._events_bytes = os.path.getsize(path)
+            except OSError:
+                self._events_bytes = 0
+            self._events_fh = open(path, "a", buffering=1)
 
     # -- registration ---------------------------------------------------
 
@@ -204,15 +225,56 @@ class MetricsRegistry:
 
     def emit(self, event: str, **fields) -> None:
         """Append one structured event (ring buffer + JSONL when a
-        directory is configured).  Values must be JSON-serialisable."""
+        directory is configured).  Values must be JSON-serialisable.
+        The JSONL stream rotates when the current segment passes the
+        size cap (``events.jsonl`` -> ``events.jsonl.1`` ...), keeping
+        the newest ``events_keep`` segments — bounded on-disk growth for
+        long-lived processes."""
         rec = {"ts": round(time.time(), 6), "event": event, **fields}
         self.events.append(rec)
         fh = self._events_fh
         if fh is not None:
+            line = json.dumps(rec, default=str) + "\n"
             try:
-                fh.write(json.dumps(rec, default=str) + "\n")
+                fh.write(line)
             except ValueError:  # closed file during teardown
-                pass
+                return
+            with self._lock:
+                self._events_bytes += len(line)
+                if self._events_bytes >= self._events_rotate_bytes:
+                    self._rotate_events_locked()
+
+    def _rotate_events_locked(self) -> None:
+        """Rotate events.jsonl (caller holds ``self._lock``).  The live
+        handle is swapped atomically under the lock so concurrent
+        emitters at worst write one late line into the segment being
+        rotated (buffering=1 keeps lines whole)."""
+        fh = self._events_fh
+        if fh is None or not self.directory:
+            return
+        path = os.path.join(self.directory, "events.jsonl")
+        try:
+            fh.close()
+            # Shift the keep-window: .(keep-1) -> dropped, ... .1 -> .2,
+            # live -> .1.  keep=0 means "no history": truncate in place.
+            for i in range(self._events_keep - 1, 0, -1):
+                src = f"{path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{path}.{i + 1}")
+            if self._events_keep > 0:
+                os.replace(path, f"{path}.1")
+            else:
+                os.unlink(path)
+            self._events_fh = open(path, "a", buffering=1)
+            self._events_bytes = 0
+        except OSError:
+            # Rotation is bookkeeping; losing it must not kill the run.
+            # Reopen append-mode so events keep flowing either way.
+            try:
+                self._events_fh = open(path, "a", buffering=1)
+                self._events_bytes = os.path.getsize(path)
+            except OSError:
+                self._events_fh = None
 
     # -- export ---------------------------------------------------------
 
@@ -335,10 +397,17 @@ def set_registry(registry: MetricsRegistry) -> MetricsRegistry:
     return prev
 
 
-def configure(directory: Optional[str]) -> MetricsRegistry:
+def configure(directory: Optional[str],
+              events_rotate_bytes: Optional[int] = None,
+              events_keep: Optional[int] = None) -> MetricsRegistry:
     """Point the process-default registry at ``directory`` (the CLI
-    drivers' ``--telemetry-dir``).  ``None`` resets to in-memory-only."""
-    return_to = MetricsRegistry(directory)
+    drivers' ``--telemetry-dir``).  ``None`` resets to in-memory-only.
+    ``events_rotate_bytes``/``events_keep`` tune the events.jsonl
+    rotation for long-lived processes (the serving daemon)."""
+    return_to = MetricsRegistry(
+        directory, events_rotate_bytes=events_rotate_bytes,
+        events_keep=events_keep,
+    )
     set_registry(return_to)
     return return_to
 

@@ -180,3 +180,69 @@ class TestMain:
         assert bc.main([old, new]) == 0
         out = capsys.readouterr().out
         assert "kafka_engine_device_reads_total: 8 -> 16" in out
+
+
+def serve_artifact(p50=5.0, p99=20.0, rejected=0, unhealthy=False,
+                   **kw):
+    art = artifact(unhealthy=unhealthy, **kw)
+    art.update({
+        "serve_p50_ms": p50, "serve_p99_ms": p99,
+        "serve_cold_ms": 800.0, "serve_rejected_total": rejected,
+        "serve_requests_total": 24,
+    })
+    return art
+
+
+class TestServeRowGating:
+    """The serving-latency rows gate like the device rows (ISSUE 8
+    satellite): >10% regression or disappearance of serve_p50_ms /
+    serve_p99_ms fails; cold-start and rejection counts stay
+    informational."""
+
+    def test_serve_rows_are_gated(self):
+        bc = _load()
+        regressions, _ = bc.compare_rows(
+            serve_artifact(), serve_artifact(p99=20.0 * 1.5)
+        )
+        assert len(regressions) == 1
+        assert "serve_p99_ms" in regressions[0]
+
+    def test_serve_regression_within_threshold_ok(self):
+        bc = _load()
+        regressions, _ = bc.compare_rows(
+            serve_artifact(), serve_artifact(p50=5.0 * 1.05)
+        )
+        assert regressions == []
+
+    def test_disappeared_serve_row_gates(self, tmp_path):
+        bc = _load()
+        old = write(tmp_path, "old.json", serve_artifact())
+        gone = serve_artifact()
+        gone["serve_p50_ms"] = None  # the failed-serve-bench null
+        new = write(tmp_path, "new.json", gone)
+        assert bc.main([old, new]) == 1
+
+    def test_rejected_and_cold_rows_not_gated(self):
+        bc = _load()
+        regressions, _ = bc.compare_rows(
+            serve_artifact(rejected=0),
+            serve_artifact(rejected=1000) | {"serve_cold_ms": 99999.0},
+        )
+        assert regressions == []
+
+    def test_old_artifact_without_serve_rows_unaffected(self, tmp_path):
+        """Pre-serving artifacts (BENCH_r0*.json) gain rows in the new
+        artifact: informational, never a gate failure."""
+        bc = _load()
+        old = write(tmp_path, "old.json", artifact())
+        new = write(tmp_path, "new.json", serve_artifact())
+        assert bc.main([old, new]) == 0
+
+    def test_serve_regression_unjudgeable_when_unhealthy(self, tmp_path,
+                                                         capsys):
+        bc = _load()
+        old = write(tmp_path, "old.json", serve_artifact())
+        new = write(tmp_path, "new.json",
+                    serve_artifact(p50=50.0, unhealthy=True))
+        assert bc.main([old, new]) == 0
+        assert "UNJUDGEABLE" in capsys.readouterr().err

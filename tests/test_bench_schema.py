@@ -25,6 +25,8 @@ EXPECTED_KEYS = [
     "device_pallas_fused_lin_ms", "device_pallas_fused_lin_ms_spread",
     "device_pallas_fused_lin_px_s",
     "e2e_pixel_steps_per_s", "e2e_device_fraction", "e2e_n_pixels",
+    "serve_p50_ms", "serve_p99_ms", "serve_cold_ms",
+    "serve_rejected_total", "serve_requests_total",
     "probe_device_ms", "probe_host_ms", "probe_retried",
     "unhealthy_reasons", "probe_host_after_ms", "unhealthy",
     "telemetry",
@@ -36,7 +38,16 @@ HEALTH_KEYS = {
 }
 
 
-def _assemble(reg, host_after_ms=0.3):
+#: a tools/loadgen.bench_serve rows dict, as the serving bench emits it.
+SERVE_ROWS = {
+    "serve_p50_ms": 4.5, "serve_p99_ms": 22.0, "serve_cold_ms": 800.0,
+    "serve_rejected_total": 0, "serve_requests_total": 24,
+    "serve_ok_total": 24, "serve_cancelled_total": 0,
+    "serve_error_total": 0,
+}
+
+
+def _assemble(reg, host_after_ms=0.3, serve=SERVE_ROWS):
     health = bench.probe_health(retry_wait_s=0.0, registry=reg)
     return health, bench.assemble_result(
         health,
@@ -46,6 +57,7 @@ def _assemble(reg, host_after_ms=0.3):
         pallas=None,           # off-TPU: the Pallas rows are never measured
         fused_lin=None,
         e2e=(5.0e4, 0.55, 7212),
+        serve=serve,
         host_after_ms=host_after_ms,
         registry=reg,
     )
@@ -119,6 +131,23 @@ class TestBenchArtifactSchema:
         assert result["vs_baseline_at_scale"] == pytest.approx(820.0)
         assert result["e2e_n_pixels"] == 7212
         assert result["oracle_ms_min"] == 154.0
+
+    def test_serve_rows_flow_through(self):
+        """The tools/loadgen serving rows land verbatim; a run whose
+        serving bench failed degrades them to null (the gate in
+        bench_compare then treats disappearance as a regression)."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            _, result = _assemble(reg)
+        assert result["serve_p50_ms"] == 4.5
+        assert result["serve_p99_ms"] == 22.0
+        assert result["serve_cold_ms"] == 800.0
+        assert result["serve_rejected_total"] == 0
+        assert result["serve_requests_total"] == 24
+        with telemetry.use(MetricsRegistry()) as reg:
+            _, result = _assemble(reg, serve=None)
+        assert result["serve_p50_ms"] is None
+        assert result["serve_p99_ms"] is None
+        assert result["serve_rejected_total"] is None
 
     def test_fused_lin_row_flows_through_on_tpu_artifacts(self):
         """When the TPU bench measures the in-kernel generation, its

@@ -1,0 +1,905 @@
+"""Assimilation-as-a-service (ISSUE 8): admission control, deadlines,
+crash-safe journal replay, warm-state incremental serving, and the
+chaos acceptance tests.
+
+Acceptance pins:
+
+- warm-path parity: a request served incrementally from a warm
+  checkpoint is IDENTICAL to a cold full-series rerun — bit-identical
+  on the unfused CPU path, within the established fused budget when
+  temporal scan fusion is on;
+- (a) overload beyond the admission threshold sheds with counted
+  rejections while every admitted request completes;
+- (b) SIGKILL of the daemon mid-request, then restart: resumes from the
+  warm checkpoint, journal replay re-serves the interrupted request,
+  and its output matches the uninterrupted run;
+- (c) SIGTERM: in-flight requests finish, new requests are rejected,
+  exit 0.
+
+All tier-1 / CPU.
+"""
+
+import datetime
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kafka_tpu import telemetry
+from kafka_tpu.engine import Checkpointer, KalmanFilter
+from kafka_tpu.resilience import POISON, RetryPolicy, faults
+from kafka_tpu.serve import (
+    AdmissionController,
+    AdmissionPolicy,
+    AssimilationService,
+    BadRequest,
+    RequestJournal,
+    ServeDaemon,
+    TileSession,
+    make_synthetic_tile,
+    parse_request,
+    read_response,
+    submit_request,
+    synthetic_dates,
+)
+from kafka_tpu.serve.synthetic import DEFAULT_BASE_DATE
+from kafka_tpu.telemetry import MetricsRegistry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the default synthetic tile's observation calendar.
+DATES = synthetic_dates(DEFAULT_BASE_DATE, 16, 2)
+
+#: zero-wait deterministic retry for the service under test.
+FAST2 = RetryPolicy(max_attempts=2, base_delay=0.0, jitter=0.0)
+
+
+def day(i):
+    return datetime.datetime(2017, 7, 1) + datetime.timedelta(days=i)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _subprocess_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop(faults.ENV_VAR, None)
+    return env
+
+
+class StubSession:
+    """Duck-typed tile session for service-mechanics tests: no JAX, the
+    solve is a recorded, optionally-blocking constant."""
+
+    def __init__(self, name="t", block=None, body=None):
+        self.name = name
+        self.block = block
+        self.body = body or {"status": "ok", "x_sha256": "stub"}
+        self.serves = 0
+        self.started = threading.Event()
+
+    def serve(self, date):
+        self.serves += 1
+        self.started.set()
+        if self.block is not None:
+            assert self.block.wait(timeout=30.0)
+        out = dict(self.body)
+        out["date"] = date.isoformat()
+        return out
+
+
+def stub_service(tmp_path, reg=None, block=None, max_queue=8, **kw):
+    sess = StubSession(block=block)
+    svc = AssimilationService(
+        {"t": sess}, str(tmp_path),
+        policy=AdmissionPolicy(max_queue_depth=max_queue),
+        retry_policy=FAST2, **kw,
+    )
+    return svc, sess
+
+
+# ---------------------------------------------------------------------------
+# request parsing
+# ---------------------------------------------------------------------------
+
+class TestParseRequest:
+    def test_roundtrip(self):
+        req = parse_request({
+            "request_id": "r-1", "tile": "t", "date": "2017-07-05",
+            "deadline_s": 3.5,
+        })
+        assert req.tile == "t" and req.date == day(4)
+        assert req.deadline is not None and req.deadline_s == 3.5
+        assert req.payload()["date"] == "2017-07-05T00:00:00"
+
+    def test_generated_id_and_default_deadline(self):
+        req = parse_request({"tile": "t", "date": "2017-07-05"},
+                            default_deadline_s=9.0)
+        assert len(req.request_id) == 16 and req.deadline_s == 9.0
+
+    @pytest.mark.parametrize("payload", [
+        "not a dict",
+        {"tile": "t"},                                    # no date
+        {"tile": "t", "date": "yesterday-ish"},
+        {"date": "2017-07-05"},                           # no tile
+        {"tile": "t", "date": "2017-07-05", "request_id": "../../etc"},
+        {"tile": "t", "date": "2017-07-05", "deadline_s": -1},
+        {"tile": "t", "date": "2017-07-05", "deadline_s": "soon"},
+    ])
+    def test_bad_requests_raise(self, payload):
+        with pytest.raises(BadRequest):
+            parse_request(payload)
+
+    def test_replayed_requests_have_no_live_deadline(self):
+        req = parse_request(
+            {"tile": "t", "date": "2017-07-05", "deadline_s": 0.001,
+             "submitted_ts": 1.0},
+            replayed=True,
+        )
+        assert req.deadline is None and req.submitted_ts == 1.0
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+class TestAdmission:
+    def test_queue_full_sheds(self):
+        ctl = AdmissionController(AdmissionPolicy(max_queue_depth=4))
+        assert ctl.decide(queue_depth=3) is None
+        assert ctl.decide(queue_depth=4) == "queue_full"
+
+    def test_writer_backlog_sheds(self):
+        with telemetry.use(MetricsRegistry()) as reg:
+            ctl = AdmissionController(
+                AdmissionPolicy(max_writer_backlog=10)
+            )
+            assert ctl.decide(0) is None
+            reg.gauge("kafka_io_writer_backlog", "").set(11)
+            assert ctl.decide(0) == "writer_backlog"
+
+    def test_prefetch_backlog_sheds(self):
+        with telemetry.use(MetricsRegistry()) as reg:
+            ctl = AdmissionController(
+                AdmissionPolicy(max_prefetch_queue_depth=8)
+            )
+            reg.gauge("kafka_prefetch_queue_depth", "").set(9)
+            assert ctl.decide(0) == "prefetch_backlog"
+
+    def test_unhealthy_verdict_sheds(self):
+        with telemetry.use(MetricsRegistry()) as reg:
+            ctl = AdmissionController(AdmissionPolicy())
+            reg.gauge("kafka_health_unhealthy", "").set(1.0)
+            assert ctl.decide(0) == "unhealthy"
+            ctl2 = AdmissionController(
+                AdmissionPolicy(shed_when_unhealthy=False)
+            )
+            assert ctl2.decide(0) is None
+
+    def test_signals_disabled_with_none(self):
+        with telemetry.use(MetricsRegistry()) as reg:
+            reg.gauge("kafka_io_writer_backlog", "").set(1e9)
+            ctl = AdmissionController(AdmissionPolicy(
+                max_writer_backlog=None,
+                max_prefetch_queue_depth=None,
+                shed_when_unhealthy=False,
+            ))
+            assert ctl.decide(0) is None
+
+
+# ---------------------------------------------------------------------------
+# journal + response store
+# ---------------------------------------------------------------------------
+
+class TestJournal:
+    def test_replay_skips_answered_and_dedupes(self, tmp_path):
+        j = RequestJournal(str(tmp_path))
+        j.record({"request_id": "a", "tile": "t", "date": "d"})
+        j.record({"request_id": "b", "tile": "t", "date": "d"})
+        j.record({"request_id": "a", "tile": "t", "date": "d"})  # dupe
+        j.respond("a", {"status": "ok"})
+        pending = j.replay()
+        assert [p["request_id"] for p in pending] == ["b"]
+        j.close()
+
+    def test_torn_tail_is_skipped_with_event(self, tmp_path):
+        with telemetry.use(MetricsRegistry()) as reg:
+            j = RequestJournal(str(tmp_path))
+            j.record({"request_id": "a", "tile": "t", "date": "d"})
+            with open(j.journal_path, "a") as f:
+                f.write('{"request_id": "tor')  # crash mid-append
+            assert [p["request_id"] for p in j.replay()] == ["a"]
+            assert any(e["event"] == "journal_torn_line"
+                       for e in reg.events)
+            j.close()
+
+    def test_response_write_is_atomic(self, tmp_path):
+        j = RequestJournal(str(tmp_path))
+        j.respond("r", {"status": "ok", "n": 1})
+        names = os.listdir(j.responses_dir)
+        assert names == ["r.json"]  # no tmp residue
+        assert j.response("r")["n"] == 1
+        assert j.response("missing") is None
+        j.close()
+
+
+# ---------------------------------------------------------------------------
+# warm-path parity (ACCEPTANCE): incremental == cold full rerun
+# ---------------------------------------------------------------------------
+
+class TestWarmPathParity:
+    def test_incremental_bit_identical_to_cold_rerun(self, tmp_path):
+        """The acceptance pin: serve D1 (cold), then D2 incrementally
+        from the warm checkpoint; a fresh cold full-series rerun through
+        D2 must produce BIT-IDENTICAL analysis arrays on the unfused CPU
+        path."""
+        warm = TileSession(make_synthetic_tile(
+            "t", str(tmp_path / "ck_warm")))
+        r1 = warm.serve(DATES[2])
+        assert r1["served_from"] == "cold"
+        r2 = warm.serve(DATES[6])
+        assert r2["served_from"] == "warm"
+        # The warm serve only ran the windows after the checkpoint.
+        assert 0 < r2["windows_run"] < len(
+            warm.spec.grid_through(DATES[6])) - 1
+
+        cold = TileSession(make_synthetic_tile(
+            "t", str(tmp_path / "ck_cold")))
+        rc = cold.serve(DATES[6])
+        assert rc["served_from"] == "cold"
+        assert r2["x_sha256"] == rc["x_sha256"]
+        np.testing.assert_array_equal(
+            warm.last_state[0], cold.last_state[0]
+        )
+        np.testing.assert_array_equal(
+            warm.last_state[1], cold.last_state[1]
+        )
+
+    def test_fused_scan_parity_within_budget(self, tmp_path):
+        """With temporal fusion on (scan_window>1) the warm and cold
+        paths bucket their scan blocks differently; parity holds within
+        the established 2e-3 fused budget."""
+        warm = TileSession(make_synthetic_tile(
+            "t", str(tmp_path / "ck_warm"), scan_window=4))
+        warm.serve(DATES[2])
+        warm.serve(DATES[-1])
+        cold = TileSession(make_synthetic_tile(
+            "t", str(tmp_path / "ck_cold"), scan_window=4))
+        cold.serve(DATES[-1])
+        np.testing.assert_allclose(
+            warm.last_state[0], cold.last_state[0], atol=2e-3
+        )
+
+    def test_noop_cache_and_replay_paths(self, tmp_path):
+        sess = TileSession(make_synthetic_tile("t", str(tmp_path / "ck")))
+        r_new = sess.serve(DATES[6])
+        # Same date again: the checkpoint already sits at the grid step.
+        r_noop = sess.serve(DATES[6])
+        assert r_noop["served_from"] == "warm_noop"
+        assert r_noop["windows_run"] == 0
+        assert r_noop["x_sha256"] == r_new["x_sha256"]
+        # A date BEHIND the warm chain replays cold without touching it.
+        before = sess.checkpointer.list_checkpoints()
+        r_old = sess.serve(DATES[2])
+        assert r_old["served_from"] == "cold_replay"
+        assert sess.checkpointer.list_checkpoints() == before
+        # ...and matches what a chain that stopped there would have.
+        ref = TileSession(make_synthetic_tile("t", str(tmp_path / "ck2")))
+        assert r_old["x_sha256"] == ref.serve(DATES[2])["x_sha256"]
+
+
+# ---------------------------------------------------------------------------
+# resume_time_grid boundary invariants (the serve path leans on these)
+# ---------------------------------------------------------------------------
+
+class TestResumeTimeGridBoundaries:
+    def _checkpoint_at(self, folder, ts, n=8, p=2):
+        ck = Checkpointer(str(folder))
+        x = np.full((n, p), 0.25, np.float32)
+        pinv = np.stack([np.eye(p, dtype=np.float32)] * n)
+        ck.save(ts, x, pinv)
+        return ck, x, pinv
+
+    def test_resume_at_midpoint_reruns_only_subsequent_dates(
+            self, tmp_path):
+        ck, _, _ = self._checkpoint_at(tmp_path, day(4))
+        grid, seed = ck.resume_time_grid([day(0), day(2), day(4), day(6)])
+        assert grid == [day(4), day(6)] and seed is not None
+
+    def test_resume_at_exactly_last_date_is_empty_remainder(
+            self, tmp_path):
+        ck, x, pinv = self._checkpoint_at(tmp_path, day(6))
+        grid, seed = ck.resume_time_grid([day(0), day(2), day(4), day(6)])
+        assert grid == [day(6)]
+        np.testing.assert_array_equal(seed[0], x)
+
+    def test_empty_remainder_run_is_a_clean_noop(self, tmp_path):
+        """A single-element grid must run ZERO windows: state out equals
+        state in, nothing dumped, nothing checkpointed — the invariant
+        the serve warm_noop path leans on."""
+        from kafka_tpu.obsops import IdentityOperator
+        from kafka_tpu.testing import MemoryOutput, SyntheticObservations
+
+        mask = np.ones((4, 8), bool)
+        op = IdentityOperator(n_params=2, obs_indices=(0, 1))
+        obs = SyntheticObservations(
+            dates=[day(i) for i in (1, 3, 5)], operator=op,
+            truth_fn=lambda d: np.full((4, 8, 2), 0.4, np.float32),
+        )
+        out = MemoryOutput()
+        kf = KalmanFilter(obs, out, mask, ("a", "b"), pad_multiple=32)
+        x0 = np.full((32, 2), 0.5, np.float32)
+        p_inv0 = np.stack([np.eye(2, dtype=np.float32)] * 32)
+        ck = Checkpointer(str(tmp_path / "ck"))
+        x, _, p_inv = kf.run([day(6)], x0, None, p_inv0,
+                             checkpointer=ck, advance_first=True)
+        np.testing.assert_array_equal(np.asarray(x), x0)
+        np.testing.assert_array_equal(np.asarray(p_inv), p_inv0)
+        assert out.output == {}
+        assert ck.list_checkpoints() == []
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-set integrity guard (multi-shard corruption falls back)
+# ---------------------------------------------------------------------------
+
+class TestShardedCheckpointIntegrity:
+    def _save_two(self, folder, n_shards=3, n=12, p=2):
+        ck = Checkpointer(str(folder), n_shards=n_shards)
+        states = {}
+        for i, ts in enumerate([day(1), day(2)]):
+            x = np.full((n, p), 0.1 * (i + 1), np.float32)
+            pinv = np.stack([np.eye(p, dtype=np.float32)] * n)
+            ck.save(ts, x, pinv)
+            states[ts] = x
+        return ck, states
+
+    def test_missing_shard_falls_back_with_event(self, tmp_path):
+        ck, states = self._save_two(tmp_path)
+        newest = ck.list_checkpoints()[-1]
+        os.remove(newest[1][1])  # shard 1 of the day-2 set vanishes
+        with telemetry.use(MetricsRegistry()) as reg:
+            ts, x, _ = ck.load_latest()
+            assert reg.value("kafka_checkpoint_unreadable_total") == 1
+            events = [e for e in reg.events
+                      if e["event"] == "checkpoint_unreadable"]
+            assert events and "incomplete" in events[0]["error"]
+        assert ts == day(1)
+        np.testing.assert_array_equal(x, states[day(1)])
+
+    def test_short_shard_falls_back(self, tmp_path):
+        ck, states = self._save_two(tmp_path)
+        newest = ck.list_checkpoints()[-1]
+        with open(newest[1][2], "r+b") as f:
+            f.truncate(30)  # torn shard write
+        with telemetry.use(MetricsRegistry()) as reg:
+            ts, x, _ = ck.load_latest()
+            assert reg.value("kafka_checkpoint_unreadable_total") == 1
+        assert ts == day(1)
+        np.testing.assert_array_equal(x, states[day(1)])
+
+    def test_inconsistent_shard_width_falls_back(self, tmp_path):
+        ck, states = self._save_two(tmp_path)
+        newest = ck.list_checkpoints()[-1]
+        # Overwrite shard 0 with a different state width — a foreign
+        # file that must read as corrupt, not silently concatenate.
+        np.savez_compressed(
+            newest[1][0].removesuffix(".npz"),
+            x_analysis=np.zeros((4, 5), np.float32),
+            p_inv_tril=np.zeros((4, 15), np.float32), p=np.int64(5),
+        )
+        with telemetry.use(MetricsRegistry()):
+            ts, x, _ = ck.load_latest()
+        assert ts == day(1)
+        np.testing.assert_array_equal(x, states[day(1)])
+
+    def test_resume_time_grid_skips_incomplete_newest(self, tmp_path):
+        ck, _ = self._save_two(tmp_path)
+        os.remove(ck.list_checkpoints()[-1][1][0])
+        with telemetry.use(MetricsRegistry()):
+            grid, seed = ck.resume_time_grid(
+                [day(0), day(1), day(2), day(3)]
+            )
+        assert grid == [day(1), day(2), day(3)] and seed is not None
+
+
+# ---------------------------------------------------------------------------
+# service mechanics (stub sessions: no JAX on these paths)
+# ---------------------------------------------------------------------------
+
+class TestServiceMechanics:
+    def test_ok_flow_and_result_cache(self, tmp_path):
+        with telemetry.use(MetricsRegistry()) as reg:
+            svc, sess = stub_service(tmp_path)
+            svc.start()
+            try:
+                svc.submit({"tile": "t", "date": "2017-07-05",
+                            "request_id": "r1"})
+                r1 = svc.result("r1", timeout_s=30)
+                assert r1["status"] == "ok" and "latency_ms" in r1
+                svc.submit({"tile": "t", "date": "2017-07-05",
+                            "request_id": "r2"})
+                r2 = svc.result("r2", timeout_s=30)
+                assert r2["served_from"] == "cache"
+                assert sess.serves == 1
+                assert reg.value("kafka_serve_cache_hits_total") == 1
+            finally:
+                svc.close()
+
+    def test_rejections_are_answered_and_counted(self, tmp_path):
+        with telemetry.use(MetricsRegistry()) as reg:
+            svc, _ = stub_service(tmp_path)
+            svc.start()
+            try:
+                ack = svc.submit({"tile": "nope", "date": "2017-07-05",
+                                  "request_id": "ru"})
+                assert ack == {"request_id": "ru", "status": "rejected",
+                               "reason": "unknown_tile"}
+                # The rejection is a RESPONSE, visible cross-process.
+                assert svc.journal.response("ru")["status"] == "rejected"
+                bad = svc.submit({"tile": "t", "request_id": "rb"})
+                assert bad["reason"] == "bad_request"
+                assert reg.value("kafka_serve_rejected_total",
+                                 reason="unknown_tile") == 1
+                assert reg.value("kafka_serve_rejected_total",
+                                 reason="bad_request") == 1
+            finally:
+                svc.close()
+
+    def test_poison_solve_answers_error_and_daemon_survives(
+            self, tmp_path):
+        with telemetry.use(MetricsRegistry()) as reg:
+            svc, sess = stub_service(tmp_path)
+            svc.start()
+            try:
+                faults.script("serve.solve", "1", POISON)
+                svc.submit({"tile": "t", "date": "2017-07-05",
+                            "request_id": "r1"})
+                r1 = svc.result("r1", timeout_s=30)
+                assert r1["status"] == "error"
+                assert "InjectedFault" in r1["error"]
+                assert reg.value("kafka_serve_errors_total") == 1
+                # The worker survives poison; the next request is fine.
+                svc.submit({"tile": "t", "date": "2017-07-07",
+                            "request_id": "r2"})
+                assert svc.result("r2", timeout_s=30)["status"] == "ok"
+            finally:
+                svc.close()
+
+    def test_transient_solve_fault_retried_in_place(self, tmp_path):
+        with telemetry.use(MetricsRegistry()) as reg:
+            svc, sess = stub_service(tmp_path)
+            svc.start()
+            try:
+                faults.script("serve.solve", "1")  # transient
+                svc.submit({"tile": "t", "date": "2017-07-05",
+                            "request_id": "r1"})
+                assert svc.result("r1", timeout_s=30)["status"] == "ok"
+                assert reg.value("kafka_resilience_retries_total",
+                                 site="serve.solve") == 1
+            finally:
+                svc.close()
+
+    def test_admit_fault_sheds_not_crashes(self, tmp_path):
+        with telemetry.use(MetricsRegistry()) as reg:
+            svc, _ = stub_service(tmp_path)
+            svc.start()
+            try:
+                faults.script("serve.admit", "1")
+                ack = svc.submit({"tile": "t", "date": "2017-07-05",
+                                  "request_id": "r1"})
+                assert ack["status"] == "rejected"
+                assert ack["reason"] == "admit_error"
+                svc.submit({"tile": "t", "date": "2017-07-05",
+                            "request_id": "r2"})
+                assert svc.result("r2", timeout_s=30)["status"] == "ok"
+            finally:
+                svc.close()
+
+    def test_transient_respond_fault_retried(self, tmp_path):
+        with telemetry.use(MetricsRegistry()):
+            svc, _ = stub_service(tmp_path)
+            svc.start()
+            try:
+                faults.script("serve.respond", "1")  # transient
+                svc.submit({"tile": "t", "date": "2017-07-05",
+                            "request_id": "r1"})
+                assert svc.result("r1", timeout_s=30)["status"] == "ok"
+            finally:
+                svc.close()
+
+    def test_lost_response_recovered_by_replay(self, tmp_path):
+        """serve.respond poison: the answer is lost but counted; because
+        no response file exists, a restart's journal replay re-serves
+        the request — the crash-between-solve-and-respond path."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            svc, sess = stub_service(tmp_path)
+            svc.start()
+            try:
+                faults.script("serve.respond", "1", POISON)
+                svc.submit({"tile": "t", "date": "2017-07-05",
+                            "request_id": "r1"})
+                deadline = time.monotonic() + 30
+                while reg.value("kafka_serve_respond_errors_total") \
+                        is None and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                assert reg.value("kafka_serve_respond_errors_total") == 1
+                assert svc.journal.response("r1") is None
+            finally:
+                svc.close()
+            faults.reset()
+            # "Restart": a fresh service over the same root replays r1.
+            svc2, sess2 = stub_service(tmp_path)
+            svc2.start()
+            try:
+                r1 = svc2.result("r1", timeout_s=30)
+                assert r1 is not None and r1["status"] == "ok"
+                assert sess2.serves == 1
+                assert reg.value("kafka_serve_replayed_total") == 1
+            finally:
+                svc2.close()
+
+    def test_expired_deadline_cancelled_and_counted(self, tmp_path):
+        """A request whose wall-clock budget ran out before its turn is
+        CANCELLED — counted and answered, never silently dropped."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            gate = threading.Event()
+            svc, sess = stub_service(tmp_path, block=gate)
+            svc.start()
+            try:
+                svc.submit({"tile": "t", "date": "2017-07-05",
+                            "request_id": "slow"})
+                assert sess.started.wait(10.0)
+                svc.submit({"tile": "t", "date": "2017-07-07",
+                            "request_id": "doomed", "deadline_s": 0.01})
+                time.sleep(0.05)  # let the deadline lapse in the queue
+                gate.set()
+                doomed = svc.result("doomed", timeout_s=30)
+                assert doomed["status"] == "cancelled"
+                assert doomed["reason"] == "deadline"
+                assert reg.value("kafka_serve_cancelled_total") == 1
+                assert svc.result("slow", timeout_s=30)["status"] == "ok"
+            finally:
+                gate.set()
+                svc.close()
+
+    def test_drain_rejects_new_finishes_admitted(self, tmp_path):
+        with telemetry.use(MetricsRegistry()):
+            gate = threading.Event()
+            svc, sess = stub_service(tmp_path, block=gate)
+            svc.start()
+            try:
+                svc.submit({"tile": "t", "date": "2017-07-05",
+                            "request_id": "inflight"})
+                assert sess.started.wait(10.0)
+                svc.submit({"tile": "t", "date": "2017-07-07",
+                            "request_id": "queued"})
+                svc.stop_admitting()
+                late = svc.submit({"tile": "t", "date": "2017-07-09",
+                                   "request_id": "late"})
+                assert late["reason"] == "draining"
+                gate.set()
+                assert svc.drain(timeout_s=30)
+                assert svc.journal.response("inflight")["status"] == "ok"
+                assert svc.journal.response("queued")["status"] == "ok"
+                assert svc.journal.response("late")["status"] == \
+                    "rejected"
+            finally:
+                gate.set()
+                svc.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos (a): overload sheds with counted rejections, admitted complete
+# ---------------------------------------------------------------------------
+
+class TestOverloadShedding:
+    def test_overload_sheds_admitted_all_complete(self, tmp_path):
+        """Deterministic overload: the worker is held on a gate, the
+        queue bound is 2, and a burst of 8 arrives — exactly 1 in-flight
+        + 2 queued are admitted, 5 shed with counted ``queue_full``
+        rejections, and every admitted request completes once the gate
+        opens."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            gate = threading.Event()
+            svc, sess = stub_service(tmp_path, block=gate, max_queue=2)
+            svc.start()
+            try:
+                acks = {}
+                for i in range(8):
+                    rid = f"r{i}"
+                    acks[rid] = svc.submit({
+                        "tile": "t", "date": "2017-07-05",
+                        "request_id": rid,
+                    })
+                    if i == 0:
+                        assert sess.started.wait(10.0)
+                queued = [r for r, a in acks.items()
+                          if a["status"] == "queued"]
+                shed = [r for r, a in acks.items()
+                        if a["status"] == "rejected"]
+                assert len(queued) == 3 and len(shed) == 5
+                assert all(acks[r]["reason"] == "queue_full"
+                           for r in shed)
+                assert reg.value("kafka_serve_rejected_total",
+                                 reason="queue_full") == 5
+                # Shed requests were ANSWERED (fast rejection), not
+                # silently dropped.
+                for rid in shed:
+                    assert svc.journal.response(rid)["status"] == \
+                        "rejected"
+                gate.set()
+                for rid in queued:
+                    got = svc.result(rid, timeout_s=30)
+                    assert got is not None and got["status"] == "ok"
+                assert reg.value("kafka_serve_admitted_total") == 3
+            finally:
+                gate.set()
+                svc.close()
+
+
+# ---------------------------------------------------------------------------
+# telemetry growth bounds for a long-lived process
+# ---------------------------------------------------------------------------
+
+class TestTelemetryGrowthBounds:
+    def test_events_jsonl_rotates_size_capped_keep_n(self, tmp_path):
+        reg = MetricsRegistry(str(tmp_path), events_rotate_bytes=600,
+                              events_keep=2)
+        for i in range(100):
+            reg.emit("filler", i=i, pad="x" * 40)
+        reg.close()
+        names = sorted(n for n in os.listdir(tmp_path)
+                       if n.startswith("events.jsonl"))
+        assert "events.jsonl" in names
+        assert "events.jsonl.1" in names and "events.jsonl.2" in names
+        assert "events.jsonl.3" not in names  # keep-N enforced
+        # Segments stay line-whole (rotation never tears a record).
+        for n in names:
+            with open(tmp_path / n) as f:
+                for line in f:
+                    assert json.loads(line)["event"] == "filler"
+        # Total on-disk telemetry is bounded near cap * (keep + 1).
+        total = sum(os.path.getsize(tmp_path / n) for n in names)
+        assert total < 600 * 4
+
+    def test_no_rotation_below_cap(self, tmp_path):
+        reg = MetricsRegistry(str(tmp_path))
+        for i in range(50):
+            reg.emit("filler", i=i)
+        reg.close()
+        assert sorted(
+            n for n in os.listdir(tmp_path) if "events" in n
+        ) == ["events.jsonl"]
+
+    def test_crash_dumps_are_capped(self, tmp_path, monkeypatch):
+        from kafka_tpu.telemetry.flight_recorder import FlightRecorder
+
+        monkeypatch.setattr(FlightRecorder, "MAX_CRASH_DUMPS", 2)
+        for i in range(4):
+            (tmp_path / f"crash_2020010{i}T000000_1.json").write_text(
+                "{}"
+            )
+        with telemetry.use(MetricsRegistry()):
+            rec = FlightRecorder(str(tmp_path))
+            path = rec.dump("unhealthy_probe")
+        names = sorted(n for n in os.listdir(tmp_path)
+                       if n.startswith("crash_"))
+        assert len(names) == 2
+        assert os.path.basename(path) in names  # newest survive
+        assert "crash_20200100T000000_1.json" not in names
+
+
+# ---------------------------------------------------------------------------
+# loadgen (in-process mode) — the serving rows
+# ---------------------------------------------------------------------------
+
+class TestLoadgen:
+    def test_bench_serve_rows(self, tmp_path):
+        from tools.loadgen import bench_serve
+
+        with telemetry.use(MetricsRegistry()):
+            rows = bench_serve(str(tmp_path), requests=6, concurrency=2)
+        assert rows["serve_ok_total"] == 6
+        assert rows["serve_error_total"] == 0
+        assert rows["serve_p50_ms"] > 0
+        assert rows["serve_p99_ms"] >= rows["serve_p50_ms"]
+        assert rows["serve_cold_ms"] > 0
+        assert rows["serve_rejected_total"] == 0
+
+    def test_rejections_counted_not_waited(self, tmp_path):
+        from tools.loadgen import _Target, run_load
+
+        with telemetry.use(MetricsRegistry()):
+            gate = threading.Event()
+            svc, _ = stub_service(tmp_path, block=gate, max_queue=1)
+            svc.start()
+            try:
+                plan = [{"tile": "t", "date": "2017-07-05"}
+                        for _ in range(6)]
+                done = {}
+
+                def release():
+                    gate.set()
+
+                t = threading.Timer(0.5, release)
+                t.start()
+                rows = run_load(_Target(service=svc), plan,
+                                concurrency=6, timeout_s=60)
+                t.cancel()
+                assert rows["serve_requests_total"] == 6
+                assert rows["serve_rejected_total"] >= 1
+                assert rows["serve_ok_total"] + \
+                    rows["serve_rejected_total"] == 6
+            finally:
+                gate.set()
+                svc.close()
+
+
+# ---------------------------------------------------------------------------
+# the daemon: filesystem transport + idle exit + crash recovery
+# ---------------------------------------------------------------------------
+
+class TestDaemonInProcess:
+    def test_inbox_roundtrip_and_idle_exit(self, tmp_path):
+        with telemetry.use(MetricsRegistry()):
+            root = str(tmp_path)
+            svc, sess = stub_service(tmp_path)
+            rid = submit_request(root, {"tile": "t",
+                                        "date": "2017-07-05"})
+            # Unparseable inbox files are dropped with an event, never a
+            # crashed daemon.
+            with open(os.path.join(root, "inbox", "garbage.json"),
+                      "w") as f:
+                f.write("{not json")
+            daemon = ServeDaemon(svc, root, poll_interval_s=0.01,
+                                 exit_when_idle=True, idle_grace_s=0.1)
+            summary = daemon.run()
+            assert summary["admitted"] == 1
+            got = read_response(root, rid)
+            assert got is not None and got["status"] == "ok"
+            assert os.listdir(os.path.join(root, "inbox")) == []
+
+
+def _daemon_cmd(root, extra=()):
+    return [
+        sys.executable, "-m", "kafka_tpu.cli.kafka_serve",
+        "--root", str(root), "--tiles", "2", "--operator", "identity",
+        "--ny", "16", "--nx", "20", "--days", "40", "--step", "2",
+        "--obs-every", "2", "--poll-interval-s", "0.02", *extra,
+    ]
+
+
+def _daemon_dates():
+    return synthetic_dates(DEFAULT_BASE_DATE, 40, 2)
+
+
+def _reference_checksum(tmp_path, date, tile_seed=0):
+    """The uninterrupted run's answer for ``date`` (same spec as the
+    daemon's tile0), computed in-process."""
+    sess = TileSession(make_synthetic_tile(
+        "tile0", str(tmp_path / "ck_ref"), operator="identity",
+        ny=16, nx=20, days=40, step_days=2, obs_every=2, seed=tile_seed,
+    ))
+    return sess.serve(date)["x_sha256"]
+
+
+class TestDaemonChaos:
+    def test_chaos_b_sigkill_midrequest_restart_replays_identically(
+            self, tmp_path):
+        """(b) SIGKILL mid-request, restart: the journal replays the
+        interrupted request, the tile resumes from the warm checkpoint
+        (not a cold rerun), and the replayed output matches the
+        uninterrupted run bit-for-bit."""
+        root = tmp_path / "serve"
+        root.mkdir()
+        date = _daemon_dates()[-1]
+        victim = subprocess.Popen(
+            _daemon_cmd(root), env=_subprocess_env(), cwd=REPO_ROOT,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        try:
+            rid = submit_request(str(root), {
+                "tile": "tile0", "date": date.isoformat(),
+                "request_id": "victimreq",
+            })
+            ck_dir = root / "ckpt_tile0"
+            deadline = time.time() + 180
+            while time.time() < deadline:
+                if victim.poll() is not None:
+                    pytest.fail(f"daemon exited rc={victim.returncode} "
+                                "before it could be killed")
+                # Kill as soon as warm state exists but the response
+                # does not: mid-request, checkpoints on disk.
+                if read_response(str(root), rid) is not None:
+                    pytest.fail("daemon answered before the kill — "
+                                "widen the request")
+                if ck_dir.is_dir() and any(
+                        n.endswith(".npz") for n in os.listdir(ck_dir)):
+                    break
+                time.sleep(0.002)
+            else:
+                pytest.fail("daemon never checkpointed")
+            victim.kill()
+            victim.wait(timeout=30)
+        finally:
+            if victim.poll() is None:
+                victim.kill()
+        assert read_response(str(root), rid) is None
+
+        # Restart: replay the journal, serve, exit when idle.
+        restarted = subprocess.run(
+            _daemon_cmd(root, extra=["--exit-when-idle",
+                                     "--idle-grace-s", "0.3"]),
+            env=_subprocess_env(), cwd=REPO_ROOT, capture_output=True,
+            text=True, timeout=600,
+        )
+        assert restarted.returncode == 0, restarted.stderr[-2000:]
+        summary = json.loads(
+            restarted.stdout.strip().splitlines()[-1])
+        assert summary["replayed"] == 1 and summary["errors"] == 0
+        got = read_response(str(root), rid)
+        assert got is not None and got["status"] == "ok"
+        # Resumed warm, not recomputed from scratch...
+        assert got["served_from"] in ("warm", "warm_noop")
+        # ...and the answer equals the uninterrupted run's, exactly.
+        assert got["x_sha256"] == _reference_checksum(tmp_path, date)
+
+    def test_chaos_c_sigterm_drains_finishes_inflight_rejects_new(
+            self, tmp_path):
+        """(c) SIGTERM: admitted requests (in-flight AND queued) finish,
+        a latecomer is answered ``rejected: draining``, exit 0."""
+        root = tmp_path / "serve"
+        root.mkdir()
+        dates = _daemon_dates()
+        daemon = subprocess.Popen(
+            _daemon_cmd(root), env=_subprocess_env(), cwd=REPO_ROOT,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        )
+        try:
+            r1 = submit_request(str(root), {
+                "tile": "tile0", "date": dates[-1].isoformat()})
+            r2 = submit_request(str(root), {
+                "tile": "tile1", "date": dates[-1].isoformat()})
+            journal = root / "requests.jsonl"
+            deadline = time.time() + 180
+            while time.time() < deadline:
+                if daemon.poll() is not None:
+                    pytest.fail(f"daemon exited rc={daemon.returncode} "
+                                "before SIGTERM")
+                text = journal.read_text() if journal.exists() else ""
+                if r1 in text and r2 in text and \
+                        read_response(str(root), r2) is None:
+                    break
+                time.sleep(0.002)
+            else:
+                pytest.fail("daemon never admitted both requests")
+            daemon.send_signal(signal.SIGTERM)
+            # New work during the drain window gets an explicit
+            # rejection, not silence.
+            r3 = submit_request(str(root), {
+                "tile": "tile0", "date": dates[0].isoformat()})
+            out, _ = daemon.communicate(timeout=600)
+        finally:
+            if daemon.poll() is None:
+                daemon.kill()
+        assert daemon.returncode == 0
+        summary = json.loads(out.strip().splitlines()[-1])
+        assert summary["drained"] is True
+        for rid in (r1, r2):
+            got = read_response(str(root), rid)
+            assert got is not None and got["status"] == "ok", rid
+        got3 = read_response(str(root), r3)
+        assert got3 is not None and got3["status"] == "rejected"
+        assert got3["reason"] == "draining"

@@ -4,7 +4,9 @@ The repo archives one BENCH JSON per round (``BENCH_r0*.json``) but
 nothing ever *read* two of them side by side — the bench trajectory was
 write-only.  This tool makes it actionable:
 
-- compares every ``device_*_ms`` timing row shared by the two artifacts
+- compares every gated timing row (``device_*_ms`` solve rows and the
+  ``serve_p50_ms``/``serve_p99_ms`` serving-latency rows) shared by the
+  two artifacts
   and **exits non-zero when any regresses by more than the threshold**
   (default 10%, new > old * 1.10) — the CI gate for perf PRs — or when
   a row the old artifact carried **disappears** from the new one (a
@@ -34,7 +36,13 @@ import sys
 from typing import Dict, List, Optional, Tuple
 
 #: timing rows gated on regression (smaller is better, milliseconds).
-DEVICE_ROW_PATTERN = "device_*_ms"
+#: ``device_*_ms`` are the solve rows; ``serve_p50_ms``/``serve_p99_ms``
+#: are the serving-latency rows (tools/loadgen.py) — the serving story
+#: gates like the solve story.  ``serve_cold_ms``/``serve_rejected_*``
+#: stay informational (cold start is setup; rejections are a policy
+#: outcome, not a latency).
+GATED_ROW_PATTERNS = ("device_*_ms", "serve_p50_ms", "serve_p99_ms")
+DEVICE_ROW_PATTERN = GATED_ROW_PATTERNS[0]  # back-compat alias
 
 
 def device_rows(artifact: dict) -> Dict[str, float]:
@@ -42,7 +50,7 @@ def device_rows(artifact: dict) -> Dict[str, float]:
     off-TPU — are dropped; spreads are diagnostics, not gates)."""
     return {
         k: float(v) for k, v in artifact.items()
-        if fnmatch.fnmatch(k, DEVICE_ROW_PATTERN)
+        if any(fnmatch.fnmatch(k, pat) for pat in GATED_ROW_PATTERNS)
         and not k.endswith("_spread")
         and isinstance(v, (int, float))
     }

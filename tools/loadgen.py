@@ -1,0 +1,278 @@
+"""Load generator for the assimilation-as-a-service daemon.
+
+Makes the serving story measurable like the solve story: fires
+concurrent tile requests at a serving target, measures per-request
+submit-to-response wall time, and emits the BENCH JSON serving rows —
+
+    serve_p50_ms / serve_p99_ms   latency percentiles over OK responses
+    serve_rejected_total          requests shed at admission
+    (+ serve_ok/cancelled/error/requests totals and serve_cold_ms, the
+     one cold-start solve paid before the timed phase)
+
+Two targets:
+
+- ``--root DIR`` drives a RUNNING ``kafka-serve`` daemon over its
+  filesystem inbox/responses transport (cross-process: what production
+  looks like);
+- ``--synthetic`` (default when no --root) builds an in-process
+  ``AssimilationService`` over synthetic tiles and drives it directly —
+  the self-contained mode ``bench.py`` embeds off-TPU.
+
+Usage:
+    python -m tools.loadgen --root /tmp/serve --requests 64 --concurrency 8
+    python -m tools.loadgen --synthetic --requests 32
+
+Exit codes: 0 ok, 1 when any request timed out or errored hard.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+def _percentiles(latencies_ms: List[float]) -> tuple:
+    if not latencies_ms:
+        return None, None
+    arr = np.asarray(latencies_ms, np.float64)
+    return (
+        round(float(np.percentile(arr, 50)), 3),
+        round(float(np.percentile(arr, 99)), 3),
+    )
+
+
+class _Target:
+    """Uniform submit/result face over the two transports."""
+
+    def __init__(self, root: Optional[str] = None, service=None,
+                 poll_interval_s: float = 0.01):
+        if (root is None) == (service is None):
+            raise ValueError("exactly one of root/service")
+        self.root = root
+        self.service = service
+        self.poll = poll_interval_s
+
+    def submit(self, payload: dict) -> dict:
+        if self.service is not None:
+            return self.service.submit(payload)
+        from kafka_tpu.serve import submit_request
+
+        rid = submit_request(self.root, payload)
+        return {"request_id": rid, "status": "queued"}
+
+    def result(self, request_id: str, timeout_s: float) -> Optional[dict]:
+        if self.service is not None:
+            return self.service.result(request_id, timeout_s=timeout_s)
+        from kafka_tpu.serve import read_response
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            got = read_response(self.root, request_id)
+            if got is not None:
+                return got
+            # kafkalint: disable=ad-hoc-retry — client-side poll of a
+            # cross-process filesystem response file: there is no failure
+            # to classify and no backoff series, just a wait for another
+            # process; a RetryPolicy would add machinery without
+            # changing behaviour.
+            time.sleep(self.poll)
+        return None
+
+
+def run_load(
+    target: _Target,
+    requests: List[dict],
+    concurrency: int = 8,
+    timeout_s: float = 300.0,
+) -> dict:
+    """Fire ``requests`` with ``concurrency`` client threads; returns
+    the serving rows.  A rejected submission is terminal immediately
+    (that IS the response — fast rejection is the overload contract);
+    everything else waits for its response file."""
+    results = []
+    lock = threading.Lock()
+    it = iter(list(enumerate(requests)))
+
+    def worker():
+        while True:
+            with lock:
+                nxt = next(it, None)
+            if nxt is None:
+                return
+            i, payload = nxt
+            payload = dict(payload)
+            payload.setdefault("request_id", f"load{i:05d}")
+            t0 = time.perf_counter()
+            ack = target.submit(payload)
+            if ack.get("status") == "rejected":
+                with lock:
+                    results.append(("rejected", ack.get("reason"), 0.0))
+                continue
+            got = target.result(payload["request_id"],
+                                timeout_s=timeout_s)
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            status = "timeout" if got is None else got.get("status", "?")
+            with lock:
+                results.append((status, None, wall_ms))
+
+    threads = [
+        # kafkalint: disable=untracked-thread — loadgen threads are the
+        # CLIENT side of the wire: they model independent external users
+        # and must not join the daemon's trace timeline.
+        threading.Thread(target=worker, name=f"loadgen-{k}", daemon=True)
+        for k in range(max(1, concurrency))
+    ]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t_start
+    ok_lat = [w for s, _, w in results if s == "ok"]
+    p50, p99 = _percentiles(ok_lat)
+    count = lambda s: sum(1 for st, _, _ in results if st == s)
+    n_ok = count("ok")
+    return {
+        "serve_p50_ms": p50,
+        "serve_p99_ms": p99,
+        "serve_requests_total": len(results),
+        "serve_ok_total": n_ok,
+        "serve_rejected_total": count("rejected"),
+        "serve_cancelled_total": count("cancelled"),
+        "serve_error_total": count("error") + count("timeout"),
+        "serve_rps": round(n_ok / wall_s, 2) if wall_s > 0 else None,
+        "serve_wall_s": round(wall_s, 3),
+    }
+
+
+def synthetic_request_plan(dates, tiles, n_requests: int) -> List[dict]:
+    """A deterministic request mix cycling tiles x dates (newest date
+    most often — the interactive-traffic shape the warm path serves)."""
+    plan = []
+    for i in range(n_requests):
+        tile = tiles[i % len(tiles)]
+        # Bias 3:1 towards the newest date; the rest walk the ladder.
+        date = dates[-1] if i % 4 else dates[i % len(dates)]
+        plan.append({"tile": tile, "date": date.isoformat()})
+    return plan
+
+
+def bench_serve(
+    tmpdir: str,
+    requests: int = 24,
+    concurrency: int = 4,
+    tiles: int = 1,
+    warm: bool = True,
+) -> dict:
+    """Self-contained serving bench (the ``bench.py`` embed): build an
+    in-process service over synthetic tiles, pay the cold start outside
+    the timed window (reported as ``serve_cold_ms``), then measure the
+    warm serving mix."""
+    from kafka_tpu.serve import (
+        AdmissionPolicy, AssimilationService, TileSession,
+        make_synthetic_tile, synthetic_dates,
+    )
+    from kafka_tpu.serve.synthetic import DEFAULT_BASE_DATE
+    import os
+
+    sessions = {}
+    for i in range(max(1, tiles)):
+        name = f"tile{i}"
+        spec = make_synthetic_tile(
+            name, ckpt_dir=os.path.join(tmpdir, f"ckpt_{name}"),
+            seed=i,
+        )
+        sessions[name] = TileSession(spec)
+    dates = synthetic_dates(DEFAULT_BASE_DATE, days=16, obs_every=2)
+    service = AssimilationService(
+        sessions, tmpdir,
+        policy=AdmissionPolicy(max_queue_depth=max(64, requests + 1)),
+    ).start()
+    try:
+        target = _Target(service=service)
+        cold_ms = None
+        if warm:
+            t0 = time.perf_counter()
+            rows = run_load(
+                target,
+                [{"tile": n, "date": dates[-1].isoformat()}
+                 for n in sessions],
+                concurrency=1, timeout_s=600.0,
+            )
+            cold_ms = round((time.perf_counter() - t0) * 1e3, 3)
+            if rows["serve_ok_total"] != len(sessions):
+                raise RuntimeError(f"serve warm-up failed: {rows}")
+        plan = synthetic_request_plan(
+            dates[-4:], sorted(sessions), requests
+        )
+        rows = run_load(target, plan, concurrency=concurrency,
+                        timeout_s=600.0)
+        rows["serve_cold_ms"] = cold_ms
+        return rows
+    finally:
+        service.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", default=None,
+                    help="serve root of a RUNNING kafka-serve daemon")
+    ap.add_argument("--synthetic", action="store_true",
+                    help="self-contained in-process service (default "
+                         "when --root is not given)")
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--tiles", default="tile0",
+                    help="comma-separated tile names (--root mode)")
+    ap.add_argument("--dates", default=None,
+                    help="comma-separated ISO dates to request (--root "
+                         "mode; default: the synthetic default ladder)")
+    ap.add_argument("--deadline-s", type=float, default=None)
+    ap.add_argument("--timeout-s", type=float, default=300.0)
+    args = ap.parse_args(argv)
+
+    if args.root:
+        from kafka_tpu.serve.synthetic import (
+            DEFAULT_BASE_DATE, synthetic_dates,
+        )
+
+        if args.dates:
+            import datetime
+
+            dates = [datetime.datetime.fromisoformat(d.strip())
+                     for d in args.dates.split(",") if d.strip()]
+        else:
+            dates = synthetic_dates(DEFAULT_BASE_DATE, 16, 2)
+        tiles = [t.strip() for t in args.tiles.split(",") if t.strip()]
+        plan = synthetic_request_plan(dates, tiles, args.requests)
+        if args.deadline_s:
+            for p in plan:
+                p["deadline_s"] = args.deadline_s
+        rows = run_load(
+            _Target(root=args.root), plan,
+            concurrency=args.concurrency, timeout_s=args.timeout_s,
+        )
+    else:
+        import tempfile
+        import shutil
+
+        tmp = tempfile.mkdtemp(prefix="kafka_loadgen_")
+        try:
+            rows = bench_serve(
+                tmp, requests=args.requests,
+                concurrency=args.concurrency,
+            )
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    print(json.dumps(rows))
+    return 1 if rows["serve_error_total"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
