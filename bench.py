@@ -434,7 +434,35 @@ def assemble_result(
         # count/sum) from the run — convergence, prefetch, io and the
         # health gauges the probes recorded.
         "telemetry": reg.flat(),
+        # Compact SOLVER-health snapshot (BASELINE.md "Numerical
+        # resilience"): the kafka_solver_* counters pulled out of the
+        # registry so tools/bench_compare.py can diff result QUALITY
+        # alongside timing — a benchmark that got faster by silently
+        # quarantining pixels must not read as a clean win.  Always
+        # present (zeros on a healthy run).
+        "solver_health": solver_health_snapshot(reg),
     }
+
+
+def solver_health_snapshot(registry=None) -> dict:
+    """The run's ``kafka_solver_*`` counter totals as a compact dict
+    (labelled series summed — e.g. clip_saturated over parameters)."""
+    reg = registry if registry is not None else get_registry()
+    out = {
+        "quarantined_pixels": 0.0,
+        "cap_bailouts": 0.0,
+        "damped_recoveries": 0.0,
+        "nonfinite": 0.0,
+        "clip_saturated": 0.0,
+    }
+    for key, val in reg.flat().items():
+        if not key.startswith("kafka_solver_"):
+            continue
+        short = key[len("kafka_solver_"):].split("{", 1)[0]
+        if short.endswith("_total"):
+            short = short[: -len("_total")]
+        out[short] = out.get(short, 0.0) + float(val)
+    return out
 
 
 def main():

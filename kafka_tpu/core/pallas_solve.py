@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import solver_health
 from .linalg import cholesky_packed, solve_chol_vectors
 
 
@@ -121,8 +122,8 @@ def solve_rows(a_rows: jnp.ndarray, b_rows: jnp.ndarray,
 
 
 def _fused_update_kernel(p: int, n_bands: int, jac_ref, h0_ref, y_ref,
-                         w_ref, m_ref, xl_ref, xf_ref, pf_ref,
-                         x_ref, a_ref, inn_ref):
+                         w_ref, m_ref, xl_ref, xf_ref, pf_ref, esc_ref,
+                         x_ref, a_ref, inn_ref, hb_ref):
     """One pixel block of the WHOLE per-date update, VMEM-resident:
 
         y~   = where(mask, y + J x_lin - H0, 0)
@@ -137,7 +138,13 @@ def _fused_update_kernel(p: int, n_bands: int, jac_ref, h0_ref, y_ref,
 
     Row layouts: ``jac`` (B*p, blk) with row ``b*p + k`` = J[b, :, k];
     ``h0/y/w/m`` (B, blk); ``xl/xf`` (p, blk); ``pf`` packed (tri(p), blk);
-    outputs ``x`` (p, blk) and ``a`` packed (tri(p), blk).
+    ``esc`` (1, blk) 0/1 — pixels under solve-health damping escalation,
+    whose FACTORED diagonal is LM-inflated (``solver_health.inflate_diag``;
+    exactly ``* 1.0 + 0.0`` for healthy pixels, and the STORED ``a`` stays
+    the uninflated Hessian either way); outputs ``x`` (p, blk), ``a``
+    packed (tri(p), blk), and ``hb`` (2, blk) — row 0 the per-pixel
+    bad-step flag (Cholesky breakdown or non-finite solve), row 1 the
+    non-finite-solve subset (``kafka_solver_nonfinite_total``'s census).
     """
 
     def idx(i, j):
@@ -178,8 +185,17 @@ def _fused_update_kernel(p: int, n_bands: int, jac_ref, h0_ref, y_ref,
         for b in range(n_bands):
             s = s + wj[b][i] * y_t[b]
         rhs.append(s)
-    l = cholesky_packed(a_pk)
+    # Factor the LM-inflated copy; a_ref keeps the true Hessian.
+    esc = esc_ref[0, :]
+    chol_in = [row[:] for row in a_pk]
+    for i in range(p):
+        chol_in[i][i] = solver_health.inflate_diag(a_pk[i][i], esc)
+    l = cholesky_packed(chol_in)
     x = solve_chol_vectors(l, rhs)
+    hb_ref[0, :] = (
+        solver_health.chol_breakdown(l) | solver_health.nonfinite_any(x)
+    ).astype(jnp.float32)
+    hb_ref[1, :] = solver_health.nonfinite_any(x).astype(jnp.float32)
     for i in range(p):
         x_ref[i, :] = x[i]
     for i in range(p):
@@ -197,8 +213,9 @@ def _fused_update_kernel(p: int, n_bands: int, jac_ref, h0_ref, y_ref,
         )
 
 
-@functools.partial(jax.jit, static_argnums=(8, 9))
+@functools.partial(jax.jit, static_argnums=(9, 10))
 def _fused_update_rows(jac_rows, h0, y, w, m, xl_rows, xf_rows, pf_rows,
+                       esc_row=None,
                        block: int = 2048, interpret: bool = False):
     n_coeff, n = pf_rows.shape
     p = xf_rows.shape[0]
@@ -206,36 +223,40 @@ def _fused_update_rows(jac_rows, h0, y, w, m, xl_rows, xf_rows, pf_rows,
     block = math.gcd(n, min(block, n))
     f32 = jnp.float32
     grid = (n // block,)
+    if esc_row is None:
+        esc_row = jnp.zeros((1, n), f32)
 
     def spec(rows):
         return pl.BlockSpec((rows, block), lambda i: (0, i))
 
-    x_rows, a_rows, inn_rows = pl.pallas_call(
+    x_rows, a_rows, inn_rows, hb_rows = pl.pallas_call(
         functools.partial(_fused_update_kernel, p, n_bands),
         out_shape=(
             jax.ShapeDtypeStruct((p, n), f32),
             jax.ShapeDtypeStruct((n_coeff, n), f32),
             jax.ShapeDtypeStruct((n_bands, n), f32),
+            jax.ShapeDtypeStruct((2, n), f32),
         ),
         grid=grid,
         in_specs=[
             spec(n_bands * p), spec(n_bands), spec(n_bands), spec(n_bands),
-            spec(n_bands), spec(p), spec(p), spec(n_coeff),
+            spec(n_bands), spec(p), spec(p), spec(n_coeff), spec(1),
         ],
-        out_specs=(spec(p), spec(n_coeff), spec(n_bands)),
+        out_specs=(spec(p), spec(n_coeff), spec(n_bands), spec(2)),
         interpret=interpret,
     )(
         jac_rows.astype(f32), h0.astype(f32), y.astype(f32),
         w.astype(f32), m.astype(f32), xl_rows.astype(f32),
-        xf_rows.astype(f32), pf_rows.astype(f32),
+        xf_rows.astype(f32), pf_rows.astype(f32), esc_row.astype(f32),
     )
-    return x_rows, a_rows, inn_rows
+    return x_rows, a_rows, inn_rows, hb_rows
 
 
 def _fused_gn_kernel(p: int, n_bands: int, min_iters: int, max_iters: int,
                      has_bounds: bool, lin_rows,
                      y_ref, w_ref, m_ref, xf_ref, pf_ref, scal_ref, bnd_ref,
-                     x_ref, a_ref, fwd_ref, inn_ref, st_ref):
+                     cor_ref,
+                     x_ref, a_ref, fwd_ref, inn_ref, st_ref, hl_ref):
     """One pixel block of the WHOLE per-date Gauss-Newton solve.
 
     Per iteration (the body of ``gn_step``, the exact math of
@@ -267,26 +288,43 @@ def _fused_gn_kernel(p: int, n_bands: int, min_iters: int, max_iters: int,
     ``lin_rows`` maps a tuple of p state lane vectors to ``(h0, jac)``
     lists with ``jac[b][k]`` already a lane row (the
     ``ObservationModel.kernel_linearize_rows`` contract).  ``scal_ref``
-    (SMEM) carries [relaxation, thresh_sq]; ``bnd_ref`` (SMEM, (2, p))
-    the per-parameter bounds.  ``st_ref`` row 0 broadcasts the block's
-    executed iteration count, row 1 its final squared step norm.
+    (SMEM) carries [relaxation, thresh_sq, moving_sq]; ``bnd_ref``
+    (SMEM, (2, p)) the per-parameter bounds; ``cor_ref`` (1, blk) the
+    ``solver.pixel`` corruption row (all zeros disarmed — the selects
+    below then keep every value bit-identical).  ``st_ref`` row 0
+    broadcasts the block's executed iteration count, row 1 its final
+    squared step norm.  ``hl_ref`` carries the per-pixel solve-health
+    outputs: row 0 the QA verdict bitmask (``core.solver_health``),
+    row 1 the ever-non-finite census, rows 2..2+p the per-parameter
+    clipped-on-every-iteration flags (bound saturation).
+
+    The solve-health iteration semantics (detect -> LM retreat ->
+    quarantine) are WORD-FOR-WORD those of the out-of-kernel loops in
+    ``core.solvers`` — the verdict parity test pins the bitmasks equal.
     """
 
     def idx(i, j):
         return i * (i + 1) // 2 + j
 
+    f32 = jnp.float32
     relax = scal_ref[0]
     thresh_sq = scal_ref[1]
+    moving_sq = scal_ref[2]
     xf = tuple(xf_ref[k, :] for k in range(p))
     y = tuple(y_ref[b, :] for b in range(n_bands))
     w = tuple(w_ref[b, :] for b in range(n_bands))
     msk = tuple(m_ref[b, :] > 0 for b in range(n_bands))
     pf = tuple(pf_ref[r, :] for r in range(tri_rows(p)))
+    cor = cor_ref[0, :] > 0
 
     def gn_step(carry):
         x = carry[0]
         n_done = carry[4]
+        esc = carry[6]
+        nonfin = carry[7]
+        clip = carry[10]
         h0, jac = lin_rows(x)
+        h0 = [solver_health.corrupt_h0(h0[b], cor) for b in range(n_bands)]
         # y~ = where(mask, y + J x - H0, 0): select, NOT mask
         # multiplication — masked-out positions hold NaN nodata
         # (io/warp.py default) and 0 * NaN = NaN would poison the solve.
@@ -312,16 +350,36 @@ def _fused_gn_kernel(p: int, n_bands: int, min_iters: int, max_iters: int,
             for b in range(n_bands):
                 s = s + wj[b][i] * y_t[b]
             rhs.append(s)
-        l = cholesky_packed(a_pk)
+        # Factor the LM-inflated copy (exactly * 1.0 + 0.0 for healthy
+        # pixels); the stored information matrix stays the true Hessian.
+        chol_in = [row[:] for row in a_pk]
+        for i in range(p):
+            chol_in[i][i] = solver_health.inflate_diag(a_pk[i][i], esc)
+        l = cholesky_packed(chol_in)
         x_raw = solve_chol_vectors(l, rhs)
-        # Damped step + physical-domain projection, identical to the
-        # while-loop body (core/solvers.py).
-        x_new = [x[k] + relax * (x_raw[k] - x[k]) for k in range(p)]
+        x_nonfin = solver_health.nonfinite_any(x_raw)
+        step_bad = solver_health.chol_breakdown(l) | x_nonfin
+        esc_now = jnp.maximum(esc, step_bad.astype(f32))
+        # LM retreat: a bad pixel discards its step and holds position;
+        # escalated pixels take shrunk-relaxation steps from here on.
+        # Damped step + physical-domain projection, otherwise identical
+        # to the while-loop body (core/solvers.py).
+        relax_eff = solver_health.damped_relaxation(relax, esc_now)
+        x_tgt = [
+            solver_health.retreat(x_raw[k], x[k], step_bad)
+            for k in range(p)
+        ]
+        x_new = [x[k] + relax_eff * (x_tgt[k] - x[k]) for k in range(p)]
         if has_bounds:
             x_new = [
                 jnp.clip(x_new[k], bnd_ref[0, k], bnd_ref[1, k])
                 for k in range(p)
             ]
+            clip = tuple(
+                clip[k] * ((x_new[k] <= bnd_ref[0, k])
+                           | (x_new[k] >= bnd_ref[1, k])).astype(f32)
+                for k in range(p)
+            )
         # fwd = J (x_new - x_f) + H0 with the damped/projected iterate
         # (reference solvers.py:70-71,135-136); innovations = y - H0
         # under the mask (:139-142).  Both from the LIVE linearisation —
@@ -335,10 +393,17 @@ def _fused_gn_kernel(p: int, n_bands: int, min_iters: int, max_iters: int,
         inn = [
             jnp.where(msk[b], y[b] - h0[b], 0.0) for b in range(n_bands)
         ]
+        ssq = (x_new[0] - x[0]) ** 2
+        for k in range(1, p):
+            ssq = ssq + (x_new[k] - x[k]) ** 2
+        # Same reduction order as the pre-health kernel (bit-stable
+        # trip counts): per-row sums, then the row-sum total.
         normsq = sum(jnp.sum((x_new[k] - x[k]) ** 2) for k in range(p))
         a_rows = tuple(a_pk[i][j] for i in range(p) for j in range(i + 1))
         return (tuple(x_new), a_rows, tuple(fwd), tuple(inn),
-                n_done + 1, normsq)
+                n_done + 1, normsq, esc_now,
+                jnp.maximum(nonfin, x_nonfin.astype(f32)),
+                step_bad.astype(f32), ssq, clip)
 
     def body(_i, carry):
         n_done, normsq = carry[4], carry[5]
@@ -353,11 +418,41 @@ def _fused_gn_kernel(p: int, n_bands: int, min_iters: int, max_iters: int,
         tuple(zero for _ in range(n_bands)),
         jnp.zeros((), jnp.int32),
         jnp.full((), jnp.inf, jnp.float32),
+        zero,                                  # esc: escalated pixels
+        zero,                                  # ever-non-finite census
+        zero,                                  # bad on the LAST step
+        zero + jnp.inf,                        # last per-pixel step^2
+        tuple(zero + 1.0 for _ in range(p)),   # clipped EVERY iteration
     )
     # Bound max_iters + 1 reproduces the while loop's post-increment cap
     # check (n_done > max_iterations): 26 solves at the reference's cap.
-    x, a_rows, fwd, inn, n_done, normsq = jax.lax.fori_loop(
-        0, max_iters + 1, body, carry0
+    (x, a_rows, fwd, inn, n_done, normsq, esc, nonfin, bad_now, ssq,
+     clip) = jax.lax.fori_loop(0, max_iters + 1, body, carry0)
+    # Quarantine with honesty: pixels still bad (or non-finite in their
+    # final state/information) fall back to the forecast with deflated
+    # information, and the QA verdict says so.
+    observed = msk[0]
+    for b in range(1, n_bands):
+        observed = observed | msk[b]
+    quar = (
+        (bad_now > 0)
+        | solver_health.nonfinite_any(list(x))
+        | solver_health.nonfinite_any(list(a_rows))
+    ) & observed
+    x = tuple(solver_health.quarantine_select(quar, xf[k], x[k])
+              for k in range(p))
+    a_rows = tuple(
+        solver_health.quarantine_select(
+            quar, solver_health.QUARANTINE_INFO_SCALE * pf[r], a_rows[r]
+        )
+        for r in range(tri_rows(p))
+    )
+    fwd = tuple(solver_health.quarantine_select(quar, zero, fwd[b])
+                for b in range(n_bands))
+    inn = tuple(solver_health.quarantine_select(quar, zero, inn[b])
+                for b in range(n_bands))
+    verd = solver_health.assemble_verdicts(
+        observed, quar, n_done > max_iters, ssq >= moving_sq, esc > 0,
     )
     for k in range(p):
         x_ref[k, :] = x[k]
@@ -368,21 +463,33 @@ def _fused_gn_kernel(p: int, n_bands: int, min_iters: int, max_iters: int,
         inn_ref[b, :] = inn[b]
     st_ref[0, :] = zero + n_done.astype(jnp.float32)
     st_ref[1, :] = zero + normsq
+    hl_ref[0, :] = verd.astype(f32)
+    hl_ref[1, :] = nonfin * observed.astype(f32)
+    for k in range(p):
+        hl_ref[2 + k, :] = (
+            (clip[k] * observed.astype(f32)) if has_bounds else zero
+        )
 
 
 def fused_gn_rows(lin_rows, y, r_inv, mask_f, xf_rows, pf_rows,
                   tol, min_iterations: int, max_iterations: int,
                   relaxation, state_bounds_rows, norm_denominator,
-                  block: int = 2048, interpret: bool = None):
+                  block: int = 2048, interpret: bool = None,
+                  corrupt=None):
     """Whole Gauss-Newton solve as ONE kernel launch per block.
 
     Row-layout driver around :func:`_fused_gn_kernel`.  ``lin_rows`` is
     the operator's bound ``kernel_linearize_rows`` (a stable callable —
     the jit cache keys on it); ``state_bounds_rows`` is ``None`` or a
-    ``(lo, hi)`` pair broadcastable to ``(p,)``.  Returns
-    ``(x_rows, a_rows, fwd, inn, n_done, norm)`` where ``n_done`` is the
-    max executed iteration count over blocks and ``norm`` the global
-    final-step norm assembled from the per-block diagnostics.
+    ``(lo, hi)`` pair broadcastable to ``(p,)``; ``corrupt`` an
+    optional (n,) 0/1 mask of pixels whose linearisation the
+    ``solver.pixel`` chaos site corrupts (zeros when disarmed).
+    Returns ``(x_rows, a_rows, fwd, inn, n_done, norm, verdicts,
+    nonfinite_count, clip_saturated)`` — ``n_done`` the max executed
+    iteration count over blocks, ``norm`` the global final-step norm
+    assembled from the per-block diagnostics, ``verdicts`` the (n,)
+    int32 solve-health QA bitmask, ``nonfinite_count`` a () int32 and
+    ``clip_saturated`` a (p,) int32 census of bound-saturated pixels.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -396,7 +503,12 @@ def fused_gn_rows(lin_rows, y, r_inv, mask_f, xf_rows, pf_rows,
     numel = jnp.asarray(norm_denominator, f32)
     # Block-local share of the global convergence test (see kernel doc).
     thresh = jnp.asarray(tol, f32) * numel * (block / n)
-    scal = jnp.stack([jnp.asarray(relaxation, f32), thresh * thresh])
+    # Per-pixel "still moving" threshold for the cap-bailout verdict:
+    # the per-pixel convergence criterion ||dx_i|| / p < tol, squared.
+    moving = jnp.asarray(tol, f32) * p
+    scal = jnp.stack([
+        jnp.asarray(relaxation, f32), thresh * thresh, moving * moving,
+    ])
     has_bounds = state_bounds_rows is not None
     if has_bounds:
         lo, hi = state_bounds_rows
@@ -406,11 +518,15 @@ def fused_gn_rows(lin_rows, y, r_inv, mask_f, xf_rows, pf_rows,
         ])
     else:
         bnd = jnp.zeros((2, p), f32)
+    cor_row = (
+        jnp.zeros((1, n), f32) if corrupt is None
+        else jnp.asarray(corrupt, f32).reshape(1, n)
+    )
 
     def spec(rows):
         return pl.BlockSpec((rows, block), lambda i: (0, i))
 
-    x_rows, a_rows, fwd, inn, st = pl.pallas_call(
+    x_rows, a_rows, fwd, inn, st, hl = pl.pallas_call(
         functools.partial(
             _fused_gn_kernel, p, n_bands, int(min_iterations),
             int(max_iterations), has_bounds, lin_rows,
@@ -421,6 +537,7 @@ def fused_gn_rows(lin_rows, y, r_inv, mask_f, xf_rows, pf_rows,
             jax.ShapeDtypeStruct((n_bands, n), f32),
             jax.ShapeDtypeStruct((n_bands, n), f32),
             jax.ShapeDtypeStruct((2, n), f32),
+            jax.ShapeDtypeStruct((2 + p, n), f32),
         ),
         grid=(n // block,),
         in_specs=[
@@ -428,21 +545,27 @@ def fused_gn_rows(lin_rows, y, r_inv, mask_f, xf_rows, pf_rows,
             spec(p), spec(n_coeff),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
+            spec(1),
         ],
         out_specs=(
             spec(p), spec(n_coeff), spec(n_bands), spec(n_bands), spec(2),
+            spec(2 + p),
         ),
         interpret=bool(interpret),
     )(
         y.astype(f32), r_inv.astype(f32), mask_f.astype(f32),
-        xf_rows.astype(f32), pf_rows.astype(f32), scal, bnd,
+        xf_rows.astype(f32), pf_rows.astype(f32), scal, bnd, cor_row,
     )
     # Per-block diagnostics ride the st rows broadcast over their block:
     # column 0 of each block carries the block's value.
     per_block = st[:, ::block]
     n_done = jnp.max(per_block[0]).astype(jnp.int32)
     norm = jnp.sqrt(jnp.sum(per_block[1])) / numel
-    return x_rows, a_rows, fwd, inn, n_done, norm
+    verdicts = hl[0].astype(jnp.int32)
+    nonfinite_count = jnp.sum(hl[1] > 0).astype(jnp.int32)
+    clip_saturated = jnp.sum(hl[2:] > 0, axis=1).astype(jnp.int32)
+    return (x_rows, a_rows, fwd, inn, n_done, norm,
+            verdicts, nonfinite_count, clip_saturated)
 
 
 def fused_update_pallas(lin, obs, x_lin: jnp.ndarray,
@@ -473,7 +596,7 @@ def fused_update_pallas(lin, obs, x_lin: jnp.ndarray,
                 for j in range(i + 1)
             ]
         )
-    x_rows, a_rows, _inn = _fused_update_rows(
+    x_rows, a_rows, _inn, _hb = _fused_update_rows(
         jac_rows, lin.h0, obs.y,
         obs.r_inv, obs.mask.astype(jnp.float32),
         x_lin.T, x_forecast.T, pf_rows,

@@ -1,0 +1,275 @@
+"""Per-pixel solve-health verdicts, adaptive damping, honest quarantine.
+
+The infrastructure around the solver became fault-tolerant in PRs 6-8,
+but the *math* still failed silently: the Gauss-Newton loop "bails at
+the cap and silently returns the last iterate", a step outside the
+operator's domain diverges without a safeguard, and one bad
+linearisation makes "Cholesky then emit NaN for that pixel forever"
+(``core/solvers.py``).  At tile-year scale a per-mille rate of
+silently-diverged pixels is thousands of corrupt values shipped with
+confident-looking uncertainties.  This module is the per-PIXEL analogue
+of the resilience layer's per-DATE degradation: detect, retreat, and —
+when retreat fails — fall back to the forecast and *say so* in the
+product.
+
+Semantics (implemented identically by all solve generations — the XLA
+while-loop in ``core.solvers.iterated_solve``, the out-of-kernel Pallas
+row loop in ``_iterated_solve_rows``, and the fully in-kernel
+``pallas_solve.fused_gn_rows``; verdict bitmasks are pinned equal
+across paths on the same inputs):
+
+1. **Detection** (every iteration, per pixel): a Gauss-Newton step is
+   *bad* when the packed Cholesky factor's diagonal is non-positive or
+   non-finite (the information matrix left the SPD cone — the silent
+   "NaN forever" failure), or when any component of the raw solve is
+   non-finite (NaN nodata that leaked past a mask, an operator
+   evaluated outside its domain).
+2. **Adaptive damping escalation** (Levenberg-Marquardt retreat): a
+   pixel flagged bad holds its position for that iteration (the bad
+   step is discarded) and, for every REMAINING iteration, solves with
+   its packed-``A`` diagonal inflated (``a_ii * DAMP_DIAG + DAMP_ABS``)
+   and its relaxation shrunk (``relaxation * DAMP_RELAX``).  Healthy
+   pixels multiply by exactly 1.0 and add exactly 0.0 — their steps are
+   bit-identical to a run without the health machinery.
+3. **Quarantine with honesty**: a pixel still bad on its LAST executed
+   iteration (or non-finite in its final state/information rows) falls
+   back to its forecast — ``x := x_forecast``, information deflated to
+   ``QUARANTINE_INFO_SCALE * p_inv_forecast`` (sigma inflated 2x) — the
+   pixel-level analogue of the engine's predict-only degraded dates.
+   The QA verdict says so; nothing pretends the solve worked.
+
+QA bitmask (written per pixel into every output GeoTIFF as the
+``solver_qa`` band; 0 = outside the state mask):
+
+================== === ==================================================
+``QA_CONVERGED``     1 pixel ended on a healthy, converged trajectory
+``QA_CAP_BAILOUT``   2 the loop hit ``max_iterations`` with this pixel
+                       still moving (per-pixel step ``||dx||/p >= tol``)
+                       — the reference's silent bailout, now labelled
+``QA_DAMPED_RECOVERED``
+                     4 the pixel was flagged bad mid-loop, took the LM
+                       retreat, and finished healthy (set alongside
+                       CONVERGED/CAP_BAILOUT)
+``QA_QUARANTINED``   8 still bad after escalation; output is the
+                       forecast with deflated information
+``QA_NODATA``       16 no valid observation in any band this window
+                       (predict-only by construction)
+================== === ==================================================
+
+Bound-saturation — a pixel pinned at ``state_bounds`` on EVERY
+iteration is a masked divergence (the projection hides an iterate that
+wants to leave the physical domain) — is tracked per parameter as
+``clip_saturated_count`` and surfaced through
+``kafka_solver_clip_saturated_total`` / the ``solver_clip_saturated``
+event rather than a QA bit: the output value is still the (clamped)
+solve, not a fabrication.
+
+This module is also the ONE sanctioned home for non-finite select logic
+in device code: kafkalint rule ``nonfinite-launder`` flags
+``jnp.nan_to_num`` / ``jnp.where(jnp.isnan(...))`` anywhere else,
+because laundering a NaN into a plausible number without raising a
+verdict is exactly the silent failure this module exists to end.
+
+Chaos hook — the ``solver.pixel`` fault site: arming
+``KAFKA_TPU_FAULTS="solver.pixel@3-5"`` (or ``faults.script``) makes
+:func:`corruption_mask` return a mask of the 0-based pixel indices
+3..5, and the solvers corrupt exactly those pixels' linearisation
+(``h0`` forced to NaN in every band) so the whole
+detect -> escalate -> quarantine -> QA path is testable
+deterministically on CPU.  The calls grammar addresses PIXELS here, not
+call numbers; the failure class is irrelevant (corruption is always
+non-finite).  Indices are positions in the solve's (padded) pixel
+batch — under a chunked run each chunk's filter has its own gather, so
+the same armed range corrupts that range in EVERY chunk.  Disarmed,
+the mask is ``None`` and no corruption argument enters the compiled
+program at all.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+# -- QA bitmask -------------------------------------------------------------
+
+QA_CONVERGED = 1
+QA_CAP_BAILOUT = 2
+QA_DAMPED_RECOVERED = 4
+QA_QUARANTINED = 8
+QA_NODATA = 16
+
+# -- escalation / quarantine constants --------------------------------------
+
+#: multiplicative LM inflation of an escalated pixel's packed-A diagonal.
+DAMP_DIAG = 10.0
+#: absolute diagonal floor added under escalation — a multiplicative
+#: inflation alone cannot rescue an EXACTLY zero diagonal (the singular
+#: prior case: 0 * 10 is still 0).
+DAMP_ABS = 1e-3
+#: relaxation multiplier for escalated pixels' remaining steps.
+DAMP_RELAX = 0.25
+#: information deflation for quarantined pixels: the forecast is served
+#: with sigma inflated by 1/sqrt(scale) = 2x, so downstream consumers
+#: that ignore the QA band still see an honestly wide uncertainty.
+QUARANTINE_INFO_SCALE = 0.25
+
+#: the chaos fault site (documented in ``resilience.faults``).
+FAULT_SITE = "solver.pixel"
+
+
+# -- detection (layout-agnostic: (n,) batch or (block,) lane vectors) -------
+
+def chol_breakdown(l) -> jnp.ndarray:
+    """Pixels whose packed Cholesky factor broke down.
+
+    ``l`` is the list-of-lists factor from ``linalg.cholesky_packed``.
+    A non-positive pivot square-roots to 0 (division blows up) or NaN;
+    either way the factor diagonal stops being a finite positive number
+    — the single test covering both the indefinite-A and the
+    NaN-poisoned-A failure, evaluated per batch/lane element.
+    """
+    p = len(l)
+    bad = jnp.zeros_like(l[0][0], dtype=bool)
+    for j in range(p):
+        d = l[j][j]
+        bad = bad | ~(d > 0) | ~jnp.isfinite(d)
+    return bad
+
+
+def nonfinite_any(vectors) -> jnp.ndarray:
+    """Elementwise OR of non-finiteness over a list of same-shape batch
+    (or lane) vectors — the per-pixel "did anything go NaN/inf" test."""
+    bad = ~jnp.isfinite(vectors[0])
+    for v in vectors[1:]:
+        bad = bad | ~jnp.isfinite(v)
+    return bad
+
+
+# -- escalation arithmetic --------------------------------------------------
+
+def inflate_diag(a_ii, esc):
+    """LM diagonal inflation: ``a_ii * DAMP_DIAG + DAMP_ABS`` where
+    ``esc`` (0/1 float, same shape) marks escalated pixels.  Healthy
+    pixels compute ``a_ii * 1.0 + 0.0`` — bit-identical."""
+    return a_ii * (1.0 + esc * (DAMP_DIAG - 1.0)) + esc * DAMP_ABS
+
+
+def damped_relaxation(relaxation, esc):
+    """Per-pixel effective relaxation: shrunk for escalated pixels,
+    exactly ``relaxation`` otherwise."""
+    return relaxation * (1.0 + esc * (DAMP_RELAX - 1.0))
+
+
+def retreat(x_raw, x_prev, bad):
+    """Discard a bad pixel's raw step: hold position instead.  The ONE
+    sanctioned non-finite select in the solve path — the replaced value
+    is never laundered into the product silently, because ``bad`` also
+    drives the escalation flags and, if it persists, the quarantine
+    verdict."""
+    return jnp.where(bad, x_prev, x_raw)
+
+
+def quarantine_select(quarantined, fallback, value):
+    """Final-output select: quarantined pixels take ``fallback`` (the
+    forecast / deflated forecast information), everything else keeps
+    ``value`` untouched.  Sanctioned here for the same reason as
+    :func:`retreat` — the replacement is always paired with the
+    ``QA_QUARANTINED`` verdict bit."""
+    return jnp.where(quarantined, fallback, value)
+
+
+# -- verdict assembly -------------------------------------------------------
+
+def assemble_verdicts(observed, quarantined, cap_exit, moving,
+                      escalated_ever) -> jnp.ndarray:
+    """Pack the per-pixel verdict bitmask (int32) from boolean vectors.
+
+    ``observed``: any valid observation in any band; ``quarantined``:
+    still-bad-after-escalation; ``cap_exit``: scalar (or broadcast) bool
+    — the loop ended via the iteration cap; ``moving``: per-pixel step
+    still >= tol at the last iteration; ``escalated_ever``: the pixel
+    took the LM retreat at least once.
+    """
+    i32 = jnp.int32
+    observed = observed.astype(bool)
+    quarantined = quarantined.astype(bool) & observed
+    bailout = (
+        jnp.broadcast_to(cap_exit, moving.shape).astype(bool)
+        & moving.astype(bool) & observed & ~quarantined
+    )
+    recovered = escalated_ever.astype(bool) & observed & ~quarantined
+    converged = observed & ~quarantined & ~bailout
+    return (
+        converged.astype(i32) * QA_CONVERGED
+        + bailout.astype(i32) * QA_CAP_BAILOUT
+        + recovered.astype(i32) * QA_DAMPED_RECOVERED
+        + quarantined.astype(i32) * QA_QUARANTINED
+        + (~observed).astype(i32) * QA_NODATA
+    )
+
+
+def verdict_counts(verdicts):
+    """Scalar census of a verdict vector: (cap_bailouts,
+    damped_recoveries, quarantined) int32 — the telemetry counters'
+    per-window increments, computed on device so they ride the packed
+    diagnostic read."""
+    i32 = jnp.int32
+    return (
+        jnp.sum((verdicts & QA_CAP_BAILOUT) > 0).astype(i32),
+        jnp.sum((verdicts & QA_DAMPED_RECOVERED) > 0).astype(i32),
+        jnp.sum((verdicts & QA_QUARANTINED) > 0).astype(i32),
+    )
+
+
+def merge_verdicts(a, b):
+    """OR-combine two verdict vectors over the same pixels (multiple
+    acquisitions in one window / band-sequential loops): any flag raised
+    in any constituent solve survives into the window's QA band, except
+    NODATA, which only holds when the pixel was unobserved in EVERY
+    solve (one observed solve clears it)."""
+    return (
+        ((a | b) & ~QA_NODATA) | (a & b & QA_NODATA)
+    ).astype(jnp.int32)
+
+
+# -- the solver.pixel chaos hook --------------------------------------------
+
+def corruption_mask(n_pix: int) -> Optional[np.ndarray]:
+    """Host-side: the armed ``solver.pixel`` fault specs as a boolean
+    (n_pix,) numpy mask of pixels whose linearisation must be corrupted
+    (0-based index ranges through the standard calls grammar), or
+    ``None`` when nothing is armed — the disarmed path adds NOTHING to
+    the compiled program (the corruption argument stays a None pytree
+    leaf)."""
+    from ..resilience import faults
+
+    if not faults.active():
+        return None
+    specs = faults.specs_for(FAULT_SITE)
+    if not specs:
+        return None
+    mask = np.zeros((n_pix,), bool)
+    for s in specs:
+        first = max(0, int(s.first))
+        last = n_pix - 1 if s.last is None else min(n_pix - 1, int(s.last))
+        if last >= first:
+            mask[first:last + 1] = True
+    if not mask.any():
+        return None
+    faults.record_injection(
+        FAULT_SITE, pixels=int(mask.sum()),
+        ranges=[[int(s.first), None if s.last is None else int(s.last)]
+                for s in specs],
+    )
+    return mask
+
+
+def corrupt_h0(h0, corrupt):
+    """Apply the scripted corruption: forecasted observations forced to
+    NaN at armed pixels (every band), making the pixel's normal
+    equations non-finite — the deterministic stand-in for an operator
+    evaluated outside its domain.  ``corrupt`` is a (n,) 0/1 float (or
+    bool) vector; ``h0`` has pixels on its LAST axis."""
+    return jnp.where(corrupt.astype(bool), jnp.float32(jnp.nan), h0)

@@ -31,8 +31,11 @@ from typing import Any, Callable, NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
+from . import solver_health
 from .linalg import (
     UNROLL_MAX_P,
+    cholesky_packed,
+    solve_chol_vectors,
     solve_spd_batched,
     solve_spd_packed,
     unpack_symmetric,
@@ -150,6 +153,31 @@ def build_normal_equations_packed(
     return a_packed, jnp.stack(b_cols, axis=-1).astype(f32)
 
 
+def _packed_update_health(lin, obs, x_lin, x_forecast, p_inv_forecast,
+                          esc):
+    """One packed update with solve-health instrumentation: same math as
+    ``build_normal_equations_packed`` + ``solve_spd_packed``, but the
+    FACTORED diagonal is LM-inflated for escalated pixels (``esc`` (n,)
+    0/1; exactly ``* 1.0 + 0.0`` — bit-identical — for healthy ones)
+    while the returned information matrix stays the true Hessian, and
+    the per-pixel breakdown/non-finite flags come back alongside.
+
+    Returns ``(x_raw, a_packed, step_bad, x_nonfin)``.
+    """
+    a_packed, b = build_normal_equations_packed(
+        lin, obs, x_lin, x_forecast, p_inv_forecast
+    )
+    p = x_forecast.shape[-1]
+    chol_in = [row[:] for row in a_packed]
+    for i in range(p):
+        chol_in[i][i] = solver_health.inflate_diag(a_packed[i][i], esc)
+    l = cholesky_packed(chol_in)
+    x_cols = solve_chol_vectors(l, [b[..., i] for i in range(p)])
+    x_nonfin = solver_health.nonfinite_any(x_cols)
+    step_bad = solver_health.chol_breakdown(l) | x_nonfin
+    return jnp.stack(x_cols, axis=-1), a_packed, step_bad, x_nonfin
+
+
 def kalman_update(
     lin: Linearization,
     obs: BandBatch,
@@ -233,6 +261,7 @@ def _iterated_solve_rows(
     norm_denominator: Any,
     linearize_block: Any,
     inkernel_linearize: bool = True,
+    corrupt: Any = None,
 ):
     """Row-layout Gauss-Newton loop around the fused Pallas update.
 
@@ -301,25 +330,28 @@ def _iterated_solve_rows(
         and isinstance(max_iterations, int)
         and kernel_bounds is not False
     ):
-        x_rows, a_rows, fwd, inn, n_done, norm = fused_gn_rows(
-            owner.kernel_linearize_rows, obs.y, obs.r_inv, mask_f,
-            xf_rows, pf_rows, tol, min_iterations, max_iterations,
-            relaxation, kernel_bounds, numel, interpret=interpret,
-        )
+        x_rows, a_rows, fwd, inn, n_done, norm, verd, nonfin, clip_sat = \
+            fused_gn_rows(
+                owner.kernel_linearize_rows, obs.y, obs.r_inv, mask_f,
+                xf_rows, pf_rows, tol, min_iterations, max_iterations,
+                relaxation, kernel_bounds, numel, interpret=interpret,
+                corrupt=corrupt,
+            )
         a_packed = [[None] * p for _ in range(p)]
         for i in range(p):
             for j in range(i + 1):
                 a_packed[i][j] = a_packed[j][i] = \
                     a_rows[i * (i + 1) // 2 + j]
         return (
-            x_rows.T, unpack_symmetric(a_packed), fwd, inn, n_done, norm
+            x_rows.T, unpack_symmetric(a_packed), fwd, inn, n_done, norm,
+            (verd, nonfin, clip_sat),
         )
 
     use_block = (
         linearize_block is not None and 0 < linearize_block < n_pix
     )
 
-    def body_step(x_rows):
+    def body_step(x_rows, esc):
         x_cols = x_rows.T
         if use_block:
             lin = _blocked_linearize(
@@ -327,12 +359,26 @@ def _iterated_solve_rows(
             )
         else:
             lin = _call_linearize(linearize, operator_params, x_cols)
+        if corrupt is not None:
+            lin = lin._replace(
+                h0=solver_health.corrupt_h0(lin.h0, corrupt)
+            )
         jac_rows = jac_to_rows(lin.jac.astype(f32))
-        x_raw, a_rows, inn = _fused_update_rows(
+        x_raw, a_rows, inn, hb = _fused_update_rows(
             jac_rows, lin.h0, obs.y, obs.r_inv, mask_f,
-            x_rows, xf_rows, pf_rows, 2048, interpret
+            x_rows, xf_rows, pf_rows, esc[None, :], 2048, interpret
         )
-        x_new = x_rows + relaxation * (x_raw - x_rows)
+        step_bad = hb[0] > 0
+        # LM retreat (solver_health semantics, identical to the other
+        # generations): bad pixels hold position, escalated pixels take
+        # shrunk-relaxation steps; healthy arithmetic is bit-identical.
+        esc_now = jnp.maximum(esc, step_bad.astype(f32))
+        x_tgt = solver_health.retreat(x_raw, x_rows, step_bad[None, :])
+        relax_eff = solver_health.damped_relaxation(
+            relaxation, esc_now
+        )[None, :]
+        x_new = x_rows + relax_eff * (x_tgt - x_rows)
+        at_bound = None
         if state_bounds is not None:
             # Accept the same bound shapes the XLA branch's
             # jnp.clip(x, lo, hi) does: scalars broadcast, (p,) vectors go
@@ -366,6 +412,7 @@ def _iterated_solve_rows(
 
             lo, hi = (to_rows(v) for v in state_bounds)
             x_new = jnp.clip(x_new, lo, hi)
+            at_bound = (x_new <= lo) | (x_new >= hi)
         # fwd = J (x - x_f) + H0 with the damped/projected iterate
         # (solvers.py:70-71,135-136).
         fwd = jnp.stack([
@@ -375,18 +422,27 @@ def _iterated_solve_rows(
             ) + lin.h0[b]
             for b in range(n_bands)
         ])
-        return x_new, a_rows, fwd, inn
+        return (x_new, a_rows, fwd, inn, esc_now, step_bad, hb[1] > 0,
+                at_bound)
 
     def cond(carry):
-        _x, _a, _f, _i, n_done, norm = carry
+        n_done, norm = carry[4], carry[5]
         converged = (norm < tol) & (n_done >= min_iterations)
         return ~(converged | (n_done > max_iterations))
 
     def body(carry):
-        x_rows, _a, _f, _i, n_done, _norm = carry
-        x_new, a_rows, fwd, inn = body_step(x_rows)
-        norm = jnp.linalg.norm(x_new - x_rows) / numel
-        return (x_new, a_rows, fwd, inn, n_done + 1, norm)
+        (x_rows, _a, _f, _i, n_done, _norm, esc, nonfin, _bad, _ssq,
+         clip) = carry
+        x_new, a_rows, fwd, inn, esc_now, step_bad, x_nonfin, at_bound = \
+            body_step(x_rows, esc)
+        if at_bound is not None:
+            clip = clip * at_bound.astype(f32)
+        step = x_new - x_rows
+        norm = jnp.linalg.norm(step) / numel
+        return (x_new, a_rows, fwd, inn, n_done + 1, norm, esc_now,
+                jnp.maximum(nonfin, x_nonfin.astype(f32)),
+                step_bad.astype(f32),
+                jnp.sum(step * step, axis=0), clip)
 
     carry0 = (
         xf_rows,
@@ -395,15 +451,160 @@ def _iterated_solve_rows(
         jnp.zeros((n_bands, n_pix), f32),
         jnp.zeros((), jnp.int32),
         jnp.full((), jnp.inf, f32),
+        jnp.zeros((n_pix,), f32),            # esc
+        jnp.zeros((n_pix,), f32),            # ever-non-finite census
+        jnp.zeros((n_pix,), f32),            # bad on the LAST step
+        jnp.full((n_pix,), jnp.inf, f32),    # last per-pixel step^2
+        jnp.ones((p, n_pix), f32),           # clipped EVERY iteration
     )
-    x_rows, a_rows, fwd, inn, n_done, norm = jax.lax.while_loop(
-        cond, body, carry0
+    (x_rows, a_rows, fwd, inn, n_done, norm, esc, nonfin, bad_now, ssq,
+     clip) = jax.lax.while_loop(cond, body, carry0)
+    # Quarantine with honesty (solver_health semantics, shared with the
+    # in-kernel path): still-bad pixels fall back to the forecast with
+    # deflated information; fwd/innovation diagnostics zero there.
+    observed = jnp.any(obs.mask, axis=0)
+    quar = (
+        (bad_now > 0)
+        | solver_health.nonfinite_any([x_rows[k] for k in range(p)])
+        | solver_health.nonfinite_any(
+            [a_rows[r] for r in range(tri_rows(p))]
+        )
+    ) & observed
+    x_rows = solver_health.quarantine_select(quar[None, :], xf_rows,
+                                             x_rows)
+    a_rows = solver_health.quarantine_select(
+        quar[None, :], solver_health.QUARANTINE_INFO_SCALE * pf_rows,
+        a_rows,
     )
+    fwd = solver_health.quarantine_select(quar[None, :], 0.0, fwd)
+    inn = solver_health.quarantine_select(quar[None, :], 0.0, inn)
+    verd = solver_health.assemble_verdicts(
+        observed, quar, n_done > max_iterations,
+        ssq >= (jnp.asarray(tol, f32) * p) ** 2, esc > 0,
+    )
+    nonfin_count = jnp.sum((nonfin > 0) & observed).astype(jnp.int32)
+    if state_bounds is not None:
+        clip_sat = jnp.sum(
+            (clip > 0) & observed[None, :], axis=1
+        ).astype(jnp.int32)
+    else:
+        clip_sat = jnp.zeros((p,), jnp.int32)
     a_packed = [[None] * p for _ in range(p)]
     for i in range(p):
         for j in range(i + 1):
             a_packed[i][j] = a_packed[j][i] = a_rows[i * (i + 1) // 2 + j]
-    return x_rows.T, unpack_symmetric(a_packed), fwd, inn, n_done, norm
+    return (x_rows.T, unpack_symmetric(a_packed), fwd, inn, n_done, norm,
+            (verd, nonfin_count, clip_sat))
+
+
+def _iterated_solve_health(
+    one_lin, obs, x_forecast, p_inv_forecast, tol, min_iterations,
+    max_iterations, relaxation, state_bounds, numel, hessian_forward,
+    operator_params,
+):
+    """Global-norm XLA Gauss-Newton loop with per-pixel solve health.
+
+    The while-loop body is the plain ``gn_step`` opened up one level —
+    ``build_normal_equations_packed`` + factor + substitute — so the
+    Cholesky factor's diagonal is inspectable per pixel, the factored
+    diagonal can be LM-inflated for escalated pixels, and the raw step
+    can be retreated from before damping.  Healthy pixels' floats are
+    bit-identical to the pre-health loop (the escalation arithmetic is
+    exactly ``* 1.0 + 0.0`` for them); the iteration-count semantics are
+    unchanged (same global norm, same cond).  Shares the detect ->
+    escalate -> quarantine semantics with the Pallas generations via
+    ``core.solver_health`` — the verdict-parity test pins the bitmasks
+    equal across all three.
+    """
+    f32 = jnp.float32
+    n_pix, p = x_forecast.shape
+    n_bands = obs.y.shape[0]
+
+    def cond(carry):
+        n_done, norm = carry[4], carry[5]
+        converged = (norm < tol) & (n_done >= min_iterations)
+        return ~(converged | (n_done > max_iterations))
+
+    def body(carry):
+        (x_prev, _a, _h0, _jac, n_done, _norm, esc, nonfin, _bad, _ssq,
+         clip) = carry
+        lin = one_lin(x_prev)
+        x_raw, a_packed, step_bad, x_nonfin = _packed_update_health(
+            lin, obs, x_prev, x_forecast, p_inv_forecast, esc
+        )
+        # LM retreat: bad pixels discard the step and hold position;
+        # escalated pixels take shrunk-relaxation steps from here on.
+        esc_now = jnp.maximum(esc, step_bad.astype(f32))
+        x_tgt = solver_health.retreat(x_raw, x_prev, step_bad[:, None])
+        relax_eff = solver_health.damped_relaxation(
+            relaxation, esc_now
+        )[:, None]
+        x_new = x_prev + relax_eff * (x_tgt - x_prev)
+        if state_bounds is not None:
+            lo, hi = state_bounds
+            x_new = jnp.clip(x_new, lo, hi)
+            clip = clip * ((x_new <= lo) | (x_new >= hi)).astype(f32)
+        step = x_new - x_prev
+        norm = jnp.linalg.norm(step) / numel
+        return (x_new, unpack_symmetric(a_packed), lin.h0, lin.jac,
+                n_done + 1, norm, esc_now,
+                jnp.maximum(nonfin, x_nonfin.astype(f32)),
+                step_bad.astype(f32),
+                jnp.sum(step * step, axis=-1), clip)
+
+    carry0 = (
+        x_forecast,
+        jnp.zeros((n_pix, p, p), f32),
+        jnp.zeros((n_bands, n_pix), f32),
+        jnp.zeros((n_bands, n_pix, p), f32),
+        jnp.zeros((), jnp.int32),
+        jnp.full((), jnp.inf, f32),
+        jnp.zeros((n_pix,), f32),            # esc
+        jnp.zeros((n_pix,), f32),            # ever-non-finite census
+        jnp.zeros((n_pix,), f32),            # bad on the LAST step
+        jnp.full((n_pix,), jnp.inf, f32),    # last per-pixel step^2
+        jnp.ones((n_pix, p), f32),           # clipped EVERY iteration
+    )
+    (x, a, h0, jac, n_done, norm, esc, nonfin, bad_now, ssq, clip) = \
+        jax.lax.while_loop(cond, body, carry0)
+    # Quarantine with honesty: still-bad pixels fall back to the
+    # forecast with deflated information; their fwd/innovation
+    # diagnostics are zeroed so chi^2 only reads assimilated pixels.
+    observed = jnp.any(obs.mask, axis=0)
+    quar = (
+        (bad_now > 0)
+        | solver_health.nonfinite_any([x[:, k] for k in range(p)])
+        | solver_health.nonfinite_any(
+            [a[:, i, j] for i in range(p) for j in range(i + 1)]
+        )
+    ) & observed
+    x = solver_health.quarantine_select(quar[:, None], x_forecast, x)
+    a = solver_health.quarantine_select(
+        quar[:, None, None],
+        solver_health.QUARANTINE_INFO_SCALE * p_inv_forecast, a,
+    )
+    fwd = jnp.einsum("bnp,np->bn", jac, x - x_forecast) + h0
+    fwd = solver_health.quarantine_select(quar[None, :], 0.0, fwd)
+    innovations = jnp.where(obs.mask, obs.y - h0, 0.0)
+    innovations = solver_health.quarantine_select(
+        quar[None, :], 0.0, innovations
+    )
+    verd = solver_health.assemble_verdicts(
+        observed, quar, n_done > max_iterations,
+        ssq >= (jnp.asarray(tol, f32) * p) ** 2, esc > 0,
+    )
+    nonfin_count = jnp.sum((nonfin > 0) & observed).astype(jnp.int32)
+    if state_bounds is not None:
+        clip_sat = jnp.sum(
+            (clip > 0) & observed[:, None], axis=0
+        ).astype(jnp.int32)
+    else:
+        clip_sat = jnp.zeros((p,), jnp.int32)
+    return _finish_solve(
+        x, a, fwd, innovations, n_done, norm, None, obs,
+        hessian_forward, operator_params, state_bounds,
+        health=(verd, nonfin_count, clip_sat),
+    )
 
 
 def iterated_solve(
@@ -423,6 +624,7 @@ def iterated_solve(
     use_pallas: bool = False,
     per_pixel_convergence: bool = False,
     inkernel_linearize: bool = True,
+    corrupt: Any = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, SolveDiagnostics]:
     """Gauss-Newton relinearisation loop as a single ``lax.while_loop``.
 
@@ -478,6 +680,24 @@ def iterated_solve(
     reference leaves oscillating at its cap.  Off by default — the
     global norm reproduces the reference exactly.
 
+    **Solve health** (``core.solver_health``): in global-norm mode on
+    the packed small-state path (p <= 16, <= 32 bands — every real
+    config; both the XLA and the Pallas generations), every pixel gets a
+    per-iteration health check (Cholesky breakdown, non-finite step), a
+    Levenberg-Marquardt damping escalation when flagged (hold position,
+    inflate the factored diagonal, shrink the relaxation — healthy
+    pixels' arithmetic is bit-identical), and an end-of-loop verdict: a
+    pixel still bad after escalation is QUARANTINED — its output is the
+    forecast with information deflated to ``QUARANTINE_INFO_SCALE *
+    p_inv_forecast`` and its fwd/innovation diagnostics zeroed — and the
+    QA bitmask (``diagnostics.health_verdicts``) says so.
+    ``per_pixel_convergence`` mode and the large-p dense fallback keep
+    their previous semantics (``health_verdicts`` is None there).
+    ``corrupt`` is the ``solver.pixel`` chaos hook: a traced (n_pix,)
+    0/1 mask of pixels whose linearisation is deterministically
+    NaN-corrupted (None — the production case — adds nothing to the
+    compiled program).
+
     ``hessian_forward`` — optional per-pixel forward model ``(p,) ->
     (n_bands,)`` (or ``(operator_params, (p,)) -> (n_bands,)``).  When
     given, the second-order Hessian correction is subtracted from the
@@ -495,13 +715,23 @@ def iterated_solve(
         linearize_block is not None and 0 < linearize_block < n_pix_total
     )
 
-    def one_solve(x_prev):
+    def one_lin(x_prev):
         if use_block:
             lin = _blocked_linearize(
                 linearize, operator_params, x_prev, int(linearize_block)
             )
         else:
             lin = _call_linearize(linearize, operator_params, x_prev)
+        if corrupt is not None:
+            # solver.pixel chaos: deterministic NaN corruption of the
+            # armed pixels' linearisation (solver_health docstring).
+            lin = lin._replace(
+                h0=solver_health.corrupt_h0(lin.h0, corrupt)
+            )
+        return lin
+
+    def one_solve(x_prev):
+        lin = one_lin(x_prev)
         x_new, a = kalman_update(
             lin, obs, x_prev, x_forecast, p_inv_forecast,
             use_pallas=use_pallas,
@@ -530,15 +760,33 @@ def iterated_solve(
         # Fused-kernel fast path (global-norm mode): the whole per-date
         # loop in row layout around one VMEM-resident Pallas kernel —
         # or, for operators advertising inkernel_linearize, INSIDE it.
-        x, a, fwd, innovations, n_done, norm = _iterated_solve_rows(
-            linearize, obs, x_forecast, p_inv_forecast, operator_params,
-            tol, min_iterations, max_iterations, relaxation,
-            state_bounds, norm_denominator, linearize_block,
-            inkernel_linearize=inkernel_linearize,
-        )
+        x, a, fwd, innovations, n_done, norm, health = \
+            _iterated_solve_rows(
+                linearize, obs, x_forecast, p_inv_forecast,
+                operator_params,
+                tol, min_iterations, max_iterations, relaxation,
+                state_bounds, norm_denominator, linearize_block,
+                inkernel_linearize=inkernel_linearize, corrupt=corrupt,
+            )
         return _finish_solve(
             x, a, fwd, innovations, n_done, norm, None, obs,
             hessian_forward, operator_params, state_bounds,
+            health=health,
+        )
+
+    if (
+        not per_pixel_convergence
+        and p <= UNROLL_MAX_P
+        and n_bands <= 32
+    ):
+        # Global-norm XLA path with solve health: the packed update is
+        # opened up (factor-level breakdown detection, LM escalation)
+        # but healthy pixels' arithmetic is bit-identical to the plain
+        # gn_step (inflate by * 1.0 + 0.0, relax by * 1.0).
+        return _iterated_solve_health(
+            one_lin, obs, x_forecast, p_inv_forecast, tol,
+            min_iterations, max_iterations, relaxation, state_bounds,
+            numel, hessian_forward, operator_params,
         )
 
     # Initial carry: no solves done yet; dummy A/h0/jac of the right shapes.
@@ -655,10 +903,13 @@ def _window_telemetry_scalars(x, innovations, obs, state_bounds):
 
 def _finish_solve(
     x, a, fwd, innovations, n_done, norm, frozen, obs,
-    hessian_forward, operator_params, state_bounds=None,
+    hessian_forward, operator_params, state_bounds=None, health=None,
 ):
     """Shared post-loop tail: optional second-order Hessian correction
-    (with the PSD guard) + diagnostics packaging."""
+    (with the PSD guard) + diagnostics packaging.  ``health`` is the
+    solve-health triple ``(verdicts, nonfinite_count,
+    clip_saturated_count)`` from paths that track it (None elsewhere —
+    the trailing SolveDiagnostics fields then stay None)."""
     if hessian_forward is not None:
         from .hessian import hessian_correction
 
@@ -687,6 +938,10 @@ def _finish_solve(
     chi2, clipped, nodata = _window_telemetry_scalars(
         x, innovations, obs, state_bounds
     )
+    verdicts = nonfin = clip_sat = cap = damped = quar = None
+    if health is not None:
+        verdicts, nonfin, clip_sat = health
+        cap, damped, quar = solver_health.verdict_counts(verdicts)
     diags = SolveDiagnostics(
         innovations=innovations,
         fwd_modelled=fwd,
@@ -696,6 +951,12 @@ def _finish_solve(
         chi2_per_band=chi2,
         clipped_count=clipped,
         nodata_count=nodata,
+        health_verdicts=verdicts,
+        cap_bailout_count=cap,
+        damped_recovered_count=damped,
+        quarantined_count=quar,
+        nonfinite_count=nonfin,
+        clip_saturated_count=clip_sat,
     )
     return x, a, diags
 
@@ -842,6 +1103,7 @@ def _assimilate_date_impl(
     inkernel_linearize: bool,
     min_iterations: Any,
     max_iterations: Any,
+    corrupt: Any = None,
 ):
     opts = dict(solver_options or {})
     if min_iterations is not None:
@@ -853,7 +1115,7 @@ def _assimilate_date_impl(
         hessian_forward=hessian_forward, linearize_block=linearize_block,
         use_pallas=use_pallas,
         per_pixel_convergence=per_pixel_convergence,
-        inkernel_linearize=inkernel_linearize, **opts
+        inkernel_linearize=inkernel_linearize, corrupt=corrupt, **opts
     )
 
 
@@ -888,6 +1150,9 @@ def assimilate_date_jit(
     per_pixel = bool(opts.pop("per_pixel_convergence", False))
     min_it = opts.pop("min_iterations", None)
     max_it = opts.pop("max_iterations", None)
+    # solver.pixel chaos hook (host-side check; None when disarmed — the
+    # production compiled program carries no corruption argument).
+    corrupt = solver_health.corruption_mask(x_forecast.shape[0])
     return _assimilate_date_impl(
         linearize, obs, x_forecast, p_inv_forecast, operator_params,
         opts or None, hessian_forward,
@@ -895,18 +1160,29 @@ def assimilate_date_jit(
         use_pallas, per_pixel, inkernel,
         None if min_it is None else int(min_it),
         None if max_it is None else int(max_it),
+        None if corrupt is None else jnp.asarray(corrupt, jnp.float32),
     )
 
 
 class ScanWindowStats(NamedTuple):
-    """Per-window telemetry scalars stacked over a fused scan block —
-    computed on device inside each scan step (same quantities as the
-    trailing ``SolveDiagnostics`` fields) so the whole block's telemetry
-    rides the block's single packed device->host read."""
+    """Per-window telemetry stacked over a fused scan block — computed
+    on device inside each scan step (same quantities as the trailing
+    ``SolveDiagnostics`` fields) so the whole block's telemetry rides
+    the block's single packed device->host read.  The solve-health
+    fields are None when the block ran a mode without health tracking
+    (per_pixel_convergence, large-p dense fallback); ``health_verdicts``
+    is the one per-PIXEL member (the QA band's source — an output
+    product like the states, not a diagnostic scalar read)."""
 
     chi2_per_band: jnp.ndarray   # (K, n_bands)
     clipped_count: jnp.ndarray   # (K,) int32
     nodata_count: jnp.ndarray    # (K,) int32
+    cap_bailout_count: Any = None       # (K,) int32
+    damped_recovered_count: Any = None  # (K,) int32
+    quarantined_count: Any = None       # (K,) int32
+    nonfinite_count: Any = None         # (K,) int32
+    clip_saturated_count: Any = None    # (K, p) int32
+    health_verdicts: Any = None         # (K, n_pix) int32 QA bitmask
 
 
 @functools.partial(jax.jit, static_argnums=(0, 9, 11, 12, 13, 14, 15, 16, 17))
@@ -929,6 +1205,7 @@ def _assimilate_scan_impl(
     inkernel_linearize: bool,
     min_iterations: Any,
     max_iterations: Any,
+    corrupt: Any = None,
 ):
     from .linalg import batched_diagonal, spd_inverse_batched
     from .propagators import advance as advance_fn
@@ -938,6 +1215,13 @@ def _assimilate_scan_impl(
         opts["min_iterations"] = min_iterations
     if max_iterations is not None:
         opts["max_iterations"] = max_iterations
+    # Structural: does this block's solve mode track health?  Mirrors
+    # the iterated_solve gating exactly (trace-time constant).
+    has_health = (
+        not per_pixel_convergence
+        and x_analysis0.shape[-1] <= UNROLL_MAX_P
+        and obs_stacked.y.shape[1] <= 32
+    )
 
     def step(carry, inp):
         x_a, p_inv_a = carry
@@ -955,7 +1239,8 @@ def _assimilate_scan_impl(
             linearize_block=linearize_block,
             use_pallas=use_pallas,
             per_pixel_convergence=per_pixel_convergence,
-            inkernel_linearize=inkernel_linearize, **opts
+            inkernel_linearize=inkernel_linearize, corrupt=corrupt,
+            **opts
         )
         out = (
             x_n, batched_diagonal(p_inv_n),
@@ -963,6 +1248,14 @@ def _assimilate_scan_impl(
             diags.chi2_per_band, diags.clipped_count,
             diags.nodata_count,
         )
+        # Solve-health outputs stack along the window axis (a static
+        # structural difference, like the per-pixel masks below).
+        if has_health:
+            out = out + (
+                diags.cap_bailout_count, diags.damped_recovered_count,
+                diags.quarantined_count, diags.nonfinite_count,
+                diags.clip_saturated_count, diags.health_verdicts,
+            )
         # Per-pixel convergence masks stack along the window axis so the
         # fused path keeps the same per-pixel diagnostics as the unfused
         # one (a static structural difference: the mode is a static arg).
@@ -974,10 +1267,20 @@ def _assimilate_scan_impl(
         step, (x_analysis0, p_inv_analysis0), (obs_stacked, aux_stacked)
     )
     xs, diag_s, iters, norms = ys[:4]
+    idx = 7
+    health = {}
+    if has_health:
+        health = dict(
+            cap_bailout_count=ys[7], damped_recovered_count=ys[8],
+            quarantined_count=ys[9], nonfinite_count=ys[10],
+            clip_saturated_count=ys[11], health_verdicts=ys[12],
+        )
+        idx = 13
     stats = ScanWindowStats(
         chi2_per_band=ys[4], clipped_count=ys[5], nodata_count=ys[6],
+        **health,
     )
-    converged = ys[7] if per_pixel_convergence else None
+    converged = ys[idx] if per_pixel_convergence else None
     return x_fin, p_inv_fin, xs, diag_s, iters, norms, converged, stats
 
 
@@ -1035,6 +1338,9 @@ def assimilate_windows_scan(
         m_matrix = jnp.eye(x_analysis0.shape[-1], dtype=jnp.float32)
     if q_diag is None:
         q_diag = jnp.zeros((x_analysis0.shape[-1],), jnp.float32)
+    # solver.pixel chaos hook — same mask for every window of the block
+    # (the armed pixel set is positional, not temporal).
+    corrupt = solver_health.corruption_mask(x_analysis0.shape[0])
     return _assimilate_scan_impl(
         linearize, obs_stacked, x_analysis0, p_inv_analysis0, aux_stacked,
         m_matrix, q_diag, prior_mean, prior_inv, state_propagator,
@@ -1043,4 +1349,5 @@ def assimilate_windows_scan(
         inkernel,
         None if min_it is None else int(min_it),
         None if max_it is None else int(max_it),
+        None if corrupt is None else jnp.asarray(corrupt, jnp.float32),
     )

@@ -117,6 +117,30 @@ class SolveDiagnostics(NamedTuple):
     #: bands, INCLUDING padding pixels (every band's mask is False there);
     #: consumers with a PixelGather subtract n_bands * (n_pad - n_valid).
     nodata_count: Any = None
+    #: (n_pix,) int32 — per-pixel solve-health QA bitmask
+    #: (``core.solver_health``: converged / cap-bailout / damped-recovered
+    #: / quarantined / nodata).  None when the solve ran a mode without
+    #: health tracking (per_pixel_convergence, the large-p dense
+    #: fallback, or the single-shot linear solve).
+    health_verdicts: Any = None
+    #: () int32 — observed pixels still moving (per-pixel step >= tol)
+    #: when the loop hit the iteration cap: the reference's silent
+    #: bailout, counted.
+    cap_bailout_count: Any = None
+    #: () int32 — pixels that went bad mid-loop, took the LM damping
+    #: escalation, and finished healthy.
+    damped_recovered_count: Any = None
+    #: () int32 — pixels still bad after escalation, served as forecast
+    #: with deflated information (QA_QUARANTINED).
+    quarantined_count: Any = None
+    #: () int32 — observed pixels whose raw Gauss-Newton step went
+    #: non-finite at least once (a subset of the escalated pixels; the
+    #: complement broke down at the Cholesky instead).
+    nonfinite_count: Any = None
+    #: (p,) int32 — per-parameter count of observed pixels clipped to a
+    #: ``state_bounds`` limit on EVERY iteration (bound saturation: a
+    #: pinned pixel is a masked divergence).  Zeros without bounds.
+    clip_saturated_count: Any = None
 
 
 def flat_to_pixel_major(x_flat: jnp.ndarray, n_params: int) -> jnp.ndarray:

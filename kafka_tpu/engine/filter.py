@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import propagators as prop
+from ..core import solver_health
 from ..core.linalg import spd_inverse_batched
 from ..core.solvers import assimilate_date_jit
 from ..core.time_grid import iterate_time_grid
@@ -134,6 +135,9 @@ class KalmanFilter:
         # Observations fetched while probing a fusion block but consumed
         # by the unfused path instead (prefetcher dates pop exactly once).
         self._pending_obs: dict = {}
+        # The current window's OR-merged solve-health QA verdicts
+        # (device array; written as the per-window solver_qa band).
+        self._window_verdicts = None
         # Graceful degradation (BASELINE.md "Fault tolerance"): a date
         # whose read exhausts its transient-failure retries is consumed
         # as a MISSING observation — the window becomes predict-only,
@@ -354,6 +358,9 @@ class KalmanFilter:
             # Covariance-form propagators (standard Kalman) hand back P, not
             # P^-1; the solver works in information space.
             p_inv_a = spd_inverse_batched(jnp.asarray(p_a, jnp.float32))
+        # Per-window solve-health QA accumulator (device array): the
+        # window's QA band is the OR-merge over its acquisitions.
+        self._window_verdicts = None
         for date in dates:
             obs = self._fetch(date)
             if obs is None:
@@ -398,6 +405,14 @@ class KalmanFilter:
                     p_inv_a, obs.aux, opts or None, hess_fwd,
                 )
             p_a = None
+            if diags.health_verdicts is not None:
+                self._window_verdicts = (
+                    diags.health_verdicts
+                    if self._window_verdicts is None
+                    else solver_health.merge_verdicts(
+                        self._window_verdicts, diags.health_verdicts
+                    )
+                )
             if self.diagnostics:
                 # One packed read: each device->host round-trip costs
                 # ~0.2 s of latency on a tunneled chip, so ALL diagnostic
@@ -419,6 +434,22 @@ class KalmanFilter:
                         diags.converged_mask[: self.gather.n_valid]
                         .astype(jnp.float32)
                     )[None])
+                # Solve-health scalars join the SAME packed read (zero
+                # added transfers; mutually exclusive with the
+                # per-pixel-convergence extra above — health only runs
+                # in global-norm mode).
+                if diags.health_verdicts is not None:
+                    parts.append(jnp.stack([
+                        jnp.asarray(diags.cap_bailout_count, jnp.float32),
+                        jnp.asarray(
+                            diags.damped_recovered_count, jnp.float32
+                        ),
+                        jnp.asarray(diags.quarantined_count, jnp.float32),
+                        jnp.asarray(diags.nonfinite_count, jnp.float32),
+                    ]))
+                    parts.append(jnp.asarray(
+                        diags.clip_saturated_count, jnp.float32
+                    ))
                 packed = fetch_scalars(jnp.concatenate(parts))
                 rec = {
                     "date": date,
@@ -433,6 +464,16 @@ class KalmanFilter:
                 }
                 if diags.converged_mask is not None:
                     rec["converged_frac"] = float(packed[4 + n_bands])
+                if diags.health_verdicts is not None:
+                    h0 = 4 + n_bands
+                    rec["cap_bailouts"] = int(packed[h0])
+                    rec["damped_recovered"] = int(packed[h0 + 1])
+                    rec["quarantined"] = int(packed[h0 + 2])
+                    rec["nonfinite"] = int(packed[h0 + 3])
+                    rec["clip_saturated"] = [
+                        int(v)
+                        for v in packed[h0 + 4:h0 + 4 + self.n_params]
+                    ]
                 self.diagnostics_log.append(rec)
                 self._record_window(rec)
                 LOG.info(
@@ -489,10 +530,60 @@ class KalmanFilter:
                 "fraction of valid pixels frozen at convergence "
                 "(per_pixel_convergence mode)",
             ).set(rec["converged_frac"])
+        if "quarantined" in rec:
+            self._record_solver_health(reg, rec)
         reg.emit(
             "solve",
             **{k: (str(v) if k == "date" else v) for k, v in rec.items()},
         )
+
+    def _record_solver_health(self, reg, rec: dict) -> None:
+        """Solve-health counters + events for one window's record
+        (BASELINE.md "Numerical resilience")."""
+        reg.counter(
+            "kafka_solver_cap_bailouts_total",
+            "observed pixels still moving when the Gauss-Newton loop "
+            "hit its iteration cap (the reference's silent bailout, "
+            "counted)",
+        ).inc(rec["cap_bailouts"])
+        reg.counter(
+            "kafka_solver_damped_recoveries_total",
+            "pixels that went numerically bad mid-loop, took the "
+            "Levenberg-Marquardt damping escalation and recovered",
+        ).inc(rec["damped_recovered"])
+        reg.counter(
+            "kafka_solver_quarantined_pixels_total",
+            "pixels still bad after damping escalation, served as "
+            "forecast with deflated information (QA_QUARANTINED)",
+        ).inc(rec["quarantined"])
+        reg.counter(
+            "kafka_solver_nonfinite_total",
+            "observed pixels whose raw Gauss-Newton step went "
+            "non-finite at least once during the loop",
+        ).inc(rec["nonfinite"])
+        sat = rec.get("clip_saturated") or []
+        c_sat = reg.counter(
+            "kafka_solver_clip_saturated_total",
+            "pixels clipped to a state_bounds limit on EVERY "
+            "iteration, per parameter — a pinned pixel is a masked "
+            "divergence",
+        )
+        for name, v in zip(self.parameter_list, sat):
+            if v:
+                c_sat.inc(v, param=name)
+        if any(sat):
+            reg.emit(
+                "solver_clip_saturated", date=str(rec["date"]),
+                counts={
+                    name: int(v)
+                    for name, v in zip(self.parameter_list, sat) if v
+                },
+            )
+        if rec["quarantined"]:
+            reg.emit(
+                "solver_pixels_quarantined", date=str(rec["date"]),
+                count=rec["quarantined"],
+            )
 
     def _band_view(self, operator, band: int):
         from ..obsops.protocol import BandView, ObservationModel
@@ -533,6 +624,8 @@ class KalmanFilter:
         innovations = []
         fwds = []
         chi2s = []
+        verds = []
+        nonfins = []
         nodata_total = None
         last_diags = None
         for b in range(n_bands):
@@ -557,6 +650,9 @@ class KalmanFilter:
                 else nodata_total + last_diags.nodata_count
             if last_diags.converged_mask is not None:
                 masks.append(last_diags.converged_mask)
+            if last_diags.health_verdicts is not None:
+                verds.append(last_diags.health_verdicts)
+                nonfins.append(last_diags.nonfinite_count)
         # Telemetry merge: chi2 concatenates (each solve saw one band),
         # nodata sums over bands, clipped is the LAST band's — the final
         # state's bound projections (summing would re-count every loop).
@@ -571,6 +667,22 @@ class KalmanFilter:
             chi2_per_band=jnp.concatenate(chi2s, axis=0),
             nodata_count=nodata_total,
         )
+        # Solve-health merge: verdict flags OR over the per-band loops
+        # (NODATA only where no band observed the pixel), scalar counts
+        # recomputed from the merged bitmask; nonfinite sums over loops;
+        # clip_saturated stays the LAST band's, like clipped above.
+        if len(verds) == n_bands and verds:
+            merged = verds[0]
+            for v in verds[1:]:
+                merged = solver_health.merge_verdicts(merged, v)
+            cap, damped, quar = solver_health.verdict_counts(merged)
+            diags = diags._replace(
+                health_verdicts=merged,
+                cap_bailout_count=cap,
+                damped_recovered_count=damped,
+                quarantined_count=quar,
+                nonfinite_count=sum(nonfins),
+            )
         return x_a, p_inv_a, diags
 
     def run(self, time_grid, x_forecast, p_forecast, p_forecast_inverse,
@@ -866,6 +978,20 @@ class KalmanFilter:
                         ts, xs[k], diag_s[k], self.gather,
                         self.parameter_list,
                     )
+            # Per-window solve-health QA bands from the stacked scan
+            # verdicts — an output product like the states (the writer
+            # pays the transfer; no diagnostic read is added).
+            if wstats.health_verdicts is not None:
+                qa_block = getattr(self.output, "dump_qa_block", None)
+                if qa_block is not None:
+                    qa_block(timesteps, wstats.health_verdicts,
+                             self.gather)
+                else:
+                    qa_one = getattr(self.output, "dump_qa", None)
+                    if qa_one is not None:
+                        for k, ts in enumerate(timesteps):
+                            qa_one(ts, wstats.health_verdicts[k],
+                                   self.gather)
         if self.diagnostics:
             k = len(timesteps)
             n_bands = first.bands.y.shape[0]
@@ -888,9 +1014,27 @@ class KalmanFilter:
                         axis=1,
                     )
                 )
+            # Solve-health scalars join the block's one packed read
+            # (mutually exclusive with the converged extra above —
+            # health runs in global-norm mode only).
+            has_health = wstats.health_verdicts is not None
+            if has_health:
+                scalars.extend([
+                    jnp.asarray(wstats.cap_bailout_count, jnp.float32),
+                    jnp.asarray(
+                        wstats.damped_recovered_count, jnp.float32
+                    ),
+                    jnp.asarray(wstats.quarantined_count, jnp.float32),
+                    jnp.asarray(wstats.nonfinite_count, jnp.float32),
+                    jnp.asarray(
+                        wstats.clip_saturated_count, jnp.float32
+                    ).reshape(-1),
+                ])
             packed = fetch_scalars(jnp.concatenate(scalars))
             wall = time.time() - t0
             chi0 = 4 * k
+            h0 = chi0 + k * n_bands + (k if converged is not None else 0)
+            p = self.n_params
             for j, ts in enumerate(timesteps):
                 rec = {
                     "date": ts,
@@ -912,6 +1056,15 @@ class KalmanFilter:
                     rec["converged_frac"] = float(
                         packed[chi0 + k * n_bands + j]
                     )
+                if has_health:
+                    rec["cap_bailouts"] = int(packed[h0 + j])
+                    rec["damped_recovered"] = int(packed[h0 + k + j])
+                    rec["quarantined"] = int(packed[h0 + 2 * k + j])
+                    rec["nonfinite"] = int(packed[h0 + 3 * k + j])
+                    sat0 = h0 + 4 * k + j * p
+                    rec["clip_saturated"] = [
+                        int(v) for v in packed[sat0:sat0 + p]
+                    ]
                 self.diagnostics_log.append(rec)
                 self._record_window(rec)
             LOG.info(
@@ -1034,6 +1187,7 @@ class KalmanFilter:
             x_analysis = x_forecast
             p_analysis = p_forecast
             p_analysis_inverse = p_forecast_inverse
+            self._window_verdicts = None
         else:
             with span("assimilate"):
                 x_analysis, p_analysis, p_analysis_inverse = (
@@ -1052,6 +1206,13 @@ class KalmanFilter:
                 timestep, x_analysis, p_inv_diag,
                 self.gather, self.parameter_list,
             )
+            # The window's solve-health QA band (an output product like
+            # x itself — no diagnostic device read involved); writers
+            # without a dump_qa simply don't get one.
+            if self._window_verdicts is not None:
+                dump_qa = getattr(self.output, "dump_qa", None)
+                if dump_qa is not None:
+                    dump_qa(timestep, self._window_verdicts, self.gather)
         self._maybe_checkpoint(
             checkpointer, timestep, x_analysis, p_analysis,
             p_analysis_inverse, n_windows=1, is_last=is_last,

@@ -111,6 +111,9 @@ class GeoTIFFOutput:
             parts.append("unc")
         return os.path.join(self.folder, "_".join(parts) + ".tif")
 
+    def _qa_fname(self, timestep: datetime.datetime) -> str:
+        return self._fname("solver_qa", timestep, False)
+
     def _write_all(self, timestep, x, unc, gather, parameter_list,
                    unc_is_sigma=False):
         t0 = time.perf_counter()
@@ -213,6 +216,55 @@ class GeoTIFFOutput:
                 gather, parameter_list, unc_is_sigma,
             )
 
+    # -- per-pixel solve-health QA band ---------------------------------
+
+    def dump_qa(self, timestep, verdicts, gather: PixelGather) -> None:
+        """Write the window's per-pixel solve-health QA band
+        (``core.solver_health`` bitmask: converged / cap-bailout /
+        damped-recovered / quarantined / nodata; 0 outside the state
+        mask) as ``solver_qa_{A%Y%j}[_{prefix}].tif`` — a uint8 raster
+        alongside every parameter/unc pair, so downstream users can MASK
+        non-converged values instead of trusting them blind."""
+        self._raise_pending()
+        if verdicts is not None and hasattr(verdicts,
+                                            "copy_to_host_async"):
+            verdicts.copy_to_host_async()
+        if self._queue is not None:
+            self._queue.put(("qa", timestep, self._snapshot(verdicts),
+                             gather))
+            self._set_backlog(self._queue.qsize())
+        else:
+            self._write_qa(timestep, verdicts, gather)
+
+    def dump_qa_block(self, timesteps, verdicts, gather: PixelGather
+                      ) -> None:
+        """QA bands for K stacked windows (``verdicts`` (K, n_pad) from
+        the fused scan): one device->host transfer for the block."""
+        self._raise_pending()
+        if verdicts is not None and hasattr(verdicts,
+                                            "copy_to_host_async"):
+            verdicts.copy_to_host_async()
+        if self._queue is not None:
+            self._queue.put(("qa_block", tuple(timesteps),
+                             self._snapshot(verdicts), gather))
+            self._set_backlog(self._queue.qsize())
+        else:
+            self._write_qa_block(timesteps, verdicts, gather)
+
+    def _write_qa(self, timestep, verdicts, gather):
+        raster = gather.scatter(
+            np.asarray(verdicts).astype(np.uint8)
+        )
+        # uint8 bitmask: byte predictor (1), not the float predictor
+        # the parameter rasters use.
+        write_geotiff(self._qa_fname(timestep), raster, self.geo,
+                      predictor=1)
+
+    def _write_qa_block(self, timesteps, verdicts, gather):
+        verdicts = np.asarray(verdicts)
+        for k, ts in enumerate(timesteps):
+            self._write_qa(ts, verdicts[k], gather)
+
     @staticmethod
     def _snapshot(arr):
         if arr is None or not isinstance(arr, np.ndarray):
@@ -233,6 +285,10 @@ class GeoTIFFOutput:
             try:
                 if item[0] == "block":
                     self._write_block(*item[1:])
+                elif item[0] == "qa":
+                    self._write_qa(*item[1:])
+                elif item[0] == "qa_block":
+                    self._write_qa_block(*item[1:])
                 else:
                     self._write_all(*item)
             except Exception as exc:  # surfaced on next dump/flush/close

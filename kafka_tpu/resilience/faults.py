@@ -39,6 +39,13 @@ In-repo sites:
 ``serve.respond``       one atomic response write (a crash between
                         solve and respond is exactly what the request
                         journal's idempotent replay recovers)
+``solver.pixel``        deterministic per-PIXEL corruption of the
+                        Gauss-Newton linearisation (``h0`` forced NaN)
+                        — the calls grammar addresses 0-based pixel
+                        index ranges, not call numbers, and nothing is
+                        raised: the armed pixels must come back
+                        QA-quarantined through the solve-health path
+                        (``core.solver_health``)
 ================== ====================================================
 
 Scripting from tests::
@@ -208,6 +215,14 @@ def active() -> bool:
     return _armed
 
 
+def specs_for(site: str) -> List[FaultSpec]:
+    """The armed specs for one site, without counting a call — for
+    sites whose "calls" grammar addresses something other than call
+    numbers (``solver.pixel`` reads its specs as pixel index ranges)."""
+    with _lock:
+        return list(_specs.get(site, ()))
+
+
 def call_count(site: str) -> int:
     """How many times ``site``'s fault point has been passed (only
     counted while armed — an idle registry costs nothing)."""
@@ -229,15 +244,22 @@ def fault_point(site: str, **context) -> None:
         )
     if spec is None:
         return
+    record_injection(
+        site, call=n, failure_class=spec.failure_class,
+        **{k: str(v) for k, v in context.items()},
+    )
+    raise InjectedFault(site, n, spec.failure_class)
+
+
+def record_injection(site: str, **fields) -> None:
+    """Land one fired fault in telemetry — the single registration site
+    for the injected-faults counter.  Raising sites go through
+    :func:`fault_point`; non-raising sites (``solver.pixel`` corrupts
+    arrays instead of raising) call this directly."""
     reg = get_registry()
     reg.counter(
         "kafka_resilience_faults_injected_total",
         "scripted failures raised by the fault-injection harness, "
         "labelled by site",
     ).inc(site=site)
-    reg.emit(
-        "fault_injected", site=site, call=n,
-        failure_class=spec.failure_class,
-        **{k: str(v) for k, v in context.items()},
-    )
-    raise InjectedFault(site, n, spec.failure_class)
+    reg.emit("fault_injected", site=site, **fields)

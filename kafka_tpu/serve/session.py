@@ -163,7 +163,8 @@ class TileSession:
                                else np.asarray(p_inv, np.float32))
         self.serves += 1
         wall_ms = (time.perf_counter() - t0) * 1e3
-        self._record(served_from, windows_run, wall_ms)
+        health = self._solver_health(kf)
+        self._record(served_from, windows_run, wall_ms, health)
         return {
             "status": "ok",
             "tile": self.name,
@@ -175,11 +176,38 @@ class TileSession:
                        for v in x_valid.mean(axis=0)],
             "x_sha256": hashlib.sha256(x_valid.tobytes()).hexdigest(),
             "wall_ms": round(wall_ms, 3),
+            # Result QUALITY, not just latency: the run's solve-health
+            # totals (BASELINE.md "Numerical resilience") so clients —
+            # and the request journal, which persists every response —
+            # can see a degraded answer for what it is.  A warm_noop /
+            # cache-style serve runs zero windows, so the totals are 0.
+            "solver_health": health,
+        }
+
+    @staticmethod
+    def _solver_health(kf) -> dict:
+        """Sum the run's per-window solve-health counts from the
+        engine's diagnostics log (zeros when the run's solve mode
+        tracked no health)."""
+        recs = [r for r in kf.diagnostics_log if "quarantined" in r]
+        return {
+            "quarantined": int(sum(r["quarantined"] for r in recs)),
+            "cap_bailouts": int(sum(r["cap_bailouts"] for r in recs)),
+            "damped_recovered": int(
+                sum(r["damped_recovered"] for r in recs)
+            ),
+            "nonfinite": int(sum(r["nonfinite"] for r in recs)),
         }
 
     def _record(self, served_from: str, windows_run: int,
-                wall_ms: float) -> None:
+                wall_ms: float, health: Optional[dict] = None) -> None:
         reg = get_registry()
+        if health and health.get("quarantined"):
+            reg.emit(
+                "serve_degraded_result", tile=self.name,
+                quarantined=health["quarantined"],
+                cap_bailouts=health.get("cap_bailouts", 0),
+            )
         reg.counter(
             "kafka_serve_solves_total",
             "tile serves by path (cold / warm / warm_noop / cold_replay)",

@@ -222,7 +222,7 @@ class MemoryOutput:
 
     def dump_data(self, timestep, x, p_inv_diag, gather: PixelGather,
                   parameter_list) -> None:
-        sol = {}
+        sol = self.output.setdefault(timestep, {})
         for ii, param in enumerate(parameter_list):
             sol[param] = gather.scatter(np.asarray(x)[:, ii])
             if p_inv_diag is not None:
@@ -232,4 +232,9 @@ class MemoryOutput:
                 sol[param + "_unc"] = gather.scatter(
                     sigma.astype(np.float32)
                 )
-        self.output[timestep] = sol
+
+    def dump_qa(self, timestep, verdicts, gather: PixelGather) -> None:
+        """Per-pixel solve-health QA bitmask raster (the in-memory
+        equivalent of GeoTIFFOutput's ``solver_qa`` band)."""
+        self.output.setdefault(timestep, {})["solver_qa"] = \
+            gather.scatter(np.asarray(verdicts).astype(np.uint8))

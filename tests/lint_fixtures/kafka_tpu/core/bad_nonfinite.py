@@ -1,0 +1,14 @@
+"""Seeded nonfinite-launder violations (outside the solver_health
+sanctuary): silently replacing NaN/inf with plausible numbers instead
+of raising a solve-health verdict."""
+
+import jax.numpy as jnp
+
+
+def launder(x, fallback):
+    a = jnp.nan_to_num(x)  # expect: nonfinite-launder
+    b = jnp.where(jnp.isnan(x), fallback, x)  # expect: nonfinite-launder
+    c = jnp.where(~jnp.isfinite(x), 0.0, x)  # expect: nonfinite-launder
+    ok_select = jnp.where(x > 0, fallback, x)
+    ok_probe = jnp.isfinite(x)  # detection alone raises no verdict lie
+    return a, b, c, ok_select, ok_probe

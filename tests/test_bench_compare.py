@@ -246,3 +246,57 @@ class TestServeRowGating:
                     serve_artifact(p50=50.0, unhealthy=True))
         assert bc.main([old, new]) == 0
         assert "UNJUDGEABLE" in capsys.readouterr().err
+
+
+def health_artifact(quarantined=0, cap=0, **kw):
+    art = artifact(**kw)
+    art["solver_health"] = {
+        "quarantined_pixels": quarantined,
+        "cap_bailouts": cap,
+        "damped_recoveries": 0,
+        "nonfinite": 0,
+        "clip_saturated": 0,
+    }
+    return art
+
+
+class TestSolverHealthDeltas:
+    """ISSUE 9 satellite: solver-health snapshot rows diff
+    informationally (like telemetry), and a NEW nonzero
+    quarantined_pixels on a previously-clean benchmark warns — never
+    gates, never silence."""
+
+    def test_deltas_reported_not_gated(self, tmp_path, capsys):
+        bc = _load()
+        old = write(tmp_path, "old.json",
+                    health_artifact(quarantined=0, cap=2))
+        new = write(tmp_path, "new.json",
+                    health_artifact(quarantined=0, cap=7))
+        assert bc.main([old, new]) == 0
+        out = capsys.readouterr().out
+        assert "solver-health deltas" in out
+        assert "cap_bailouts: 2 -> 7" in out
+
+    def test_new_nonzero_quarantined_warns(self, tmp_path, capsys):
+        bc = _load()
+        old = write(tmp_path, "old.json", health_artifact(quarantined=0))
+        new = write(tmp_path, "new.json", health_artifact(quarantined=5))
+        assert bc.main([old, new]) == 0  # a warning, not a gate
+        err = capsys.readouterr().err
+        assert "WARNING" in err and "quarantined_pixels went 0 -> 5" in err
+
+    def test_preexisting_quarantine_does_not_warn(self, tmp_path, capsys):
+        bc = _load()
+        old = write(tmp_path, "old.json", health_artifact(quarantined=4))
+        new = write(tmp_path, "new.json", health_artifact(quarantined=5))
+        assert bc.main([old, new]) == 0
+        assert "WARNING" not in capsys.readouterr().err
+
+    def test_artifacts_without_snapshot_unaffected(self, tmp_path, capsys):
+        bc = _load()
+        old = write(tmp_path, "old.json", artifact())
+        new = write(tmp_path, "new.json", artifact())
+        assert bc.main([old, new]) == 0
+        out = capsys.readouterr()
+        assert "solver-health deltas" not in out.out
+        assert "WARNING" not in out.err

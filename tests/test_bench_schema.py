@@ -29,7 +29,7 @@ EXPECTED_KEYS = [
     "serve_rejected_total", "serve_requests_total",
     "probe_device_ms", "probe_host_ms", "probe_retried",
     "unhealthy_reasons", "probe_host_after_ms", "unhealthy",
-    "telemetry",
+    "telemetry", "solver_health",
 ]
 
 HEALTH_KEYS = {
@@ -98,6 +98,33 @@ class TestBenchArtifactSchema:
         assert tel["kafka_health_probe_host_ms"] == host_gauge
         assert round(host_gauge, 3) == result["probe_host_ms"]
         assert "kafka_health_unhealthy" in tel
+
+    def test_solver_health_snapshot_always_present(self):
+        """The solver-health snapshot rides every artifact (zeros on a
+        healthy run) and sums labelled series, so bench_compare can
+        diff result quality without special-casing missing keys."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            reg.counter(
+                "kafka_solver_quarantined_pixels_total", "t"
+            ).inc(3)
+            reg.counter(
+                "kafka_solver_clip_saturated_total", "t"
+            ).inc(2, param="lai")
+            reg.counter(
+                "kafka_solver_clip_saturated_total", "t"
+            ).inc(1, param="sm")
+            _, result = _assemble(reg)
+        snap = result["solver_health"]
+        assert snap["quarantined_pixels"] == 3
+        assert snap["clip_saturated"] == 3  # summed over param labels
+        assert snap["cap_bailouts"] == 0  # present even when unseen
+        with telemetry.use(MetricsRegistry()) as reg:
+            _, clean = _assemble(reg)
+        assert set(clean["solver_health"]) >= {
+            "quarantined_pixels", "cap_bailouts", "damped_recoveries",
+            "nonfinite", "clip_saturated",
+        }
+        assert all(v == 0 for v in clean["solver_health"].values())
 
     def test_json_serialisable_one_line(self):
         with telemetry.use(MetricsRegistry()) as reg:

@@ -34,7 +34,7 @@ ALL_RULES = {
     "host-transfer-in-jit", "implicit-f64", "untracked-thread",
     "bare-except", "static-arg-flag", "metric-name", "event-name",
     "event-collision", "kernel-relayout", "ad-hoc-retry",
-    "naive-marker-write",
+    "naive-marker-write", "nonfinite-launder",
 }
 
 
@@ -223,7 +223,7 @@ def test_json_output_schema(capsys):
     payload = json.loads(capsys.readouterr().out)
     assert payload["version"] == 1
     assert payload["root"] == os.path.abspath(FIXTURES)
-    assert payload["files_scanned"] == 10
+    assert payload["files_scanned"] == 11
     assert set(payload["rules"]) >= ALL_RULES
     assert isinstance(payload["findings"], list) and payload["findings"]
     for f in payload["findings"]:

@@ -903,3 +903,68 @@ class TestDaemonChaos:
         got3 = read_response(str(root), r3)
         assert got3 is not None and got3["status"] == "rejected"
         assert got3["reason"] == "draining"
+
+
+# ---------------------------------------------------------------------------
+# solve-health in responses (ISSUE 9 satellite): result quality, not
+# just latency
+# ---------------------------------------------------------------------------
+
+class TestServeSolverHealth:
+    def test_response_carries_solver_health_counts(self, tmp_path):
+        with telemetry.use(MetricsRegistry()):
+            spec = make_synthetic_tile(
+                "t", ckpt_dir=str(tmp_path / "ckpt"), seed=0
+            )
+            sess = TileSession(spec)
+            body = sess.serve(DATES[2])
+        health = body["solver_health"]
+        assert set(health) == {
+            "quarantined", "cap_bailouts", "damped_recovered",
+            "nonfinite",
+        }
+        assert all(isinstance(v, int) for v in health.values())
+        # a clean synthetic tile converges everywhere
+        assert health["quarantined"] == 0
+        assert health["nonfinite"] == 0
+
+    def test_warm_noop_serve_reports_zero_health(self, tmp_path):
+        with telemetry.use(MetricsRegistry()):
+            spec = make_synthetic_tile(
+                "t", ckpt_dir=str(tmp_path / "ckpt"), seed=0
+            )
+            sess = TileSession(spec)
+            sess.serve(DATES[2])
+            body = sess.serve(DATES[2])  # zero windows re-run
+        assert body["served_from"] == "warm_noop"
+        assert body["solver_health"]["quarantined"] == 0
+
+    def test_quarantined_pixels_reach_response_and_loadgen(self,
+                                                          tmp_path):
+        """solver.pixel chaos through the whole serving stack: the
+        armed pixels' quarantine count lands in the response body, the
+        journal's persisted response, and the loadgen quality rows."""
+        from tools.loadgen import _Target, run_load
+
+        faults.script("solver.pixel", "0-2")
+        with telemetry.use(MetricsRegistry()):
+            spec = make_synthetic_tile(
+                "t", ckpt_dir=str(tmp_path / "ckpt"), seed=0
+            )
+            svc = AssimilationService(
+                {"t": TileSession(spec)}, str(tmp_path)
+            ).start()
+            try:
+                rows = run_load(
+                    _Target(service=svc),
+                    [{"tile": "t", "date": DATES[2].isoformat(),
+                      "request_id": "rq0"}],
+                    concurrency=1, timeout_s=120,
+                )
+                got = read_response(str(tmp_path), "rq0")
+            finally:
+                svc.close()
+        assert rows["serve_ok_total"] == 1
+        assert got["solver_health"]["quarantined"] > 0
+        assert rows["serve_quarantined_pixels"] == \
+            got["solver_health"]["quarantined"]

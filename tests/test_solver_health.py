@@ -1,0 +1,600 @@
+"""Numerical resilience (ISSUE 9): per-pixel solve-health verdicts,
+adaptive damping escalation, QA-masked graceful degradation.
+
+Acceptance pins:
+
+- a ``solver.pixel``-seeded run with k deliberately-divergent pixels
+  completes rc 0 with EXACTLY those pixels QA-flagged quarantined
+  (forecast-valued, inflated uncertainty) while every healthy pixel's
+  outputs are bit-identical (unfused) / within the 2e-3 budget (fused)
+  to the fault-free run;
+- the fused (in-kernel and out-of-kernel Pallas) and unfused (XLA)
+  generations produce IDENTICAL verdict bitmasks on the same inputs;
+- ``kafka_engine_device_reads_total == dispatches`` still holds — the
+  health scalars ride the existing packed read, the QA band rides the
+  output path.
+
+All tier-1 / CPU.
+"""
+
+import datetime
+import json
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from kafka_tpu import telemetry
+from kafka_tpu.core import (
+    BandBatch,
+    Linearization,
+    iterated_solve,
+    kalman_update,
+)
+from kafka_tpu.core import solver_health as sh
+from kafka_tpu.resilience import faults
+from kafka_tpu.telemetry import MetricsRegistry
+
+RNG = np.random.default_rng(7)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _problem(n=48, p=3, n_bands=2, mask_frac=0.0, seed=3):
+    rng = np.random.default_rng(seed)
+    jac = rng.normal(size=(n_bands, n, p)).astype(np.float32)
+    h0 = rng.normal(size=(n_bands, n)).astype(np.float32)
+    y = rng.normal(size=(n_bands, n)).astype(np.float32)
+    r_inv = rng.uniform(0.5, 2.0, size=(n_bands, n)).astype(np.float32)
+    mask = rng.uniform(size=(n_bands, n)) > mask_frac
+    x_f = rng.normal(size=(n, p)).astype(np.float32)
+    w = rng.normal(size=(n, p, p)).astype(np.float32)
+    p_inv = np.einsum("npq,nrq->npr", w, w) + \
+        3.0 * np.eye(p, dtype=np.float32)
+    obs = BandBatch(
+        y=jnp.asarray(np.where(mask, y, np.nan).astype(np.float32)),
+        r_inv=jnp.asarray(np.where(mask, r_inv, 0.0).astype(np.float32)),
+        mask=jnp.asarray(mask),
+    )
+    lin = lambda x: Linearization(h0=jnp.asarray(h0), jac=jnp.asarray(jac))
+    return lin, obs, jnp.asarray(x_f), jnp.asarray(p_inv), mask
+
+
+# ---------------------------------------------------------------------------
+# solver_health unit surface
+# ---------------------------------------------------------------------------
+
+class TestHealthUnits:
+    def test_escalation_arithmetic_identity_for_healthy(self):
+        """The LM inflation/relaxation formulas are EXACT no-ops at
+        esc=0 — the bit-identity guarantee's arithmetic core."""
+        a = jnp.asarray(RNG.normal(size=(256,)).astype(np.float32))
+        zero = jnp.zeros_like(a)
+        assert (np.asarray(sh.inflate_diag(a, zero)) ==
+                np.asarray(a)).all()
+        r = jnp.float32(0.7)
+        assert (np.asarray(sh.damped_relaxation(r, zero)) ==
+                np.float32(0.7)).all()
+
+    def test_chol_breakdown_flags_nonpositive_pivot(self):
+        from kafka_tpu.core.linalg import cholesky_packed
+
+        a_ok = [[jnp.asarray([4.0, 4.0])]]
+        a_bad = [[jnp.asarray([0.0, -1.0])]]
+        assert not np.asarray(
+            sh.chol_breakdown(cholesky_packed(a_ok))
+        ).any()
+        assert np.asarray(
+            sh.chol_breakdown(cholesky_packed(a_bad))
+        ).all()
+
+    def test_assemble_and_count_verdicts(self):
+        observed = jnp.asarray([True, True, True, True, False])
+        quar = jnp.asarray([False, True, False, False, False])
+        moving = jnp.asarray([False, True, True, False, True])
+        esc = jnp.asarray([False, True, False, True, False])
+        v = np.asarray(sh.assemble_verdicts(
+            observed, quar, jnp.asarray(True), moving, esc
+        ))
+        assert v[0] == sh.QA_CONVERGED
+        assert v[1] == sh.QA_QUARANTINED          # quarantine wins
+        assert v[2] == sh.QA_CAP_BAILOUT          # moving at the cap
+        assert v[3] == sh.QA_CONVERGED | sh.QA_DAMPED_RECOVERED
+        assert v[4] == sh.QA_NODATA               # unobserved
+        cap, damped, q = sh.verdict_counts(jnp.asarray(v))
+        assert (int(cap), int(damped), int(q)) == (1, 1, 1)
+
+    def test_merge_verdicts_semantics(self):
+        a = jnp.asarray([sh.QA_CONVERGED, sh.QA_NODATA,
+                         sh.QA_QUARANTINED, sh.QA_NODATA], jnp.int32)
+        b = jnp.asarray([sh.QA_CAP_BAILOUT, sh.QA_CONVERGED,
+                         sh.QA_CONVERGED, sh.QA_NODATA], jnp.int32)
+        m = np.asarray(sh.merge_verdicts(a, b))
+        assert m[0] == sh.QA_CONVERGED | sh.QA_CAP_BAILOUT
+        # one observed solve clears NODATA
+        assert m[1] == sh.QA_CONVERGED
+        assert m[2] == sh.QA_QUARANTINED | sh.QA_CONVERGED
+        # unobserved in EVERY solve stays NODATA
+        assert m[3] == sh.QA_NODATA
+
+    def test_corruption_mask_pixel_grammar(self):
+        assert sh.corruption_mask(16) is None  # disarmed: no argument
+        faults.script("solver.pixel", "3-5")
+        faults.script("solver.pixel", "9")
+        with telemetry.use(MetricsRegistry()) as reg:
+            mask = sh.corruption_mask(16)
+        assert list(np.nonzero(mask)[0]) == [3, 4, 5, 9]
+        assert reg.value(
+            "kafka_resilience_faults_injected_total",
+            site="solver.pixel",
+        ) == 1
+        assert any(e["event"] == "fault_injected" for e in reg.events)
+
+    def test_corruption_open_range_clamps_to_batch(self):
+        faults.script("solver.pixel", "14+")
+        mask = sh.corruption_mask(16)
+        assert list(np.nonzero(mask)[0]) == [14, 15]
+
+
+# ---------------------------------------------------------------------------
+# verdict parity across the three solve generations
+# ---------------------------------------------------------------------------
+
+class _QuadOp:
+    inkernel_linearize = True
+
+    def __init__(self, coeff):
+        self.coeff = np.asarray(coeff, np.float32)
+
+    def linearize(self, aux, x):
+        c = jnp.asarray(self.coeff)
+        return Linearization(
+            h0=jnp.einsum("bp,np->bn", c, x**2),
+            jac=2.0 * c[:, None, :] * x[None, :, :],
+        )
+
+    def kernel_linearize_rows(self, x_rows):
+        B, p = self.coeff.shape
+        h0 = [sum(float(c[k]) * x_rows[k] ** 2 for k in range(p))
+              for c in self.coeff]
+        jac = [[2.0 * float(c[k]) * x_rows[k] for k in range(p)]
+               for c in self.coeff]
+        return h0, jac
+
+
+class TestVerdictParity:
+    def _quad(self, n=64, p=3, n_bands=2, seed=11):
+        rng = np.random.default_rng(seed)
+        coeff = rng.uniform(0.5, 1.5, size=(n_bands, p)).astype(
+            np.float32
+        )
+        op = _QuadOp(coeff)
+        x_f = np.full((n, p), 0.8, np.float32)
+        x_true = x_f + rng.normal(0, 0.05, (n, p)).astype(np.float32)
+        y = np.einsum("bp,np->bn", coeff, x_true**2).astype(np.float32)
+        mask = rng.uniform(size=y.shape) > 0.2
+        obs = BandBatch(
+            y=jnp.asarray(np.where(mask, y, np.nan).astype(np.float32)),
+            r_inv=jnp.asarray(np.where(mask, 25.0, 0.0).astype(
+                np.float32
+            )),
+            mask=jnp.asarray(mask),
+        )
+        p_inv = np.broadcast_to(
+            4.0 * np.eye(p, dtype=np.float32), (n, p, p)
+        ).copy()
+        bounds = (jnp.full((p,), -10.0, jnp.float32),
+                  jnp.full((p,), 10.0, jnp.float32))
+        return op, obs, jnp.asarray(x_f), jnp.asarray(p_inv), bounds, \
+            mask
+
+    def _three_ways(self, corrupt=None):
+        op, obs, x_f, p_inv, bounds, mask = self._quad()
+        out = {}
+        for name, kw in (
+            ("xla", {}),
+            ("rows", dict(use_pallas=True, inkernel_linearize=False)),
+            ("kernel", dict(use_pallas=True)),
+        ):
+            out[name] = iterated_solve(
+                op.linearize, obs, x_f, p_inv, state_bounds=bounds,
+                corrupt=corrupt, **kw
+            )
+        return out, mask
+
+    def test_identical_bitmasks_clean(self):
+        out, _ = self._three_ways()
+        v = {k: np.asarray(d.health_verdicts) for k, (_, _, d) in
+             out.items()}
+        np.testing.assert_array_equal(v["xla"], v["rows"])
+        np.testing.assert_array_equal(v["xla"], v["kernel"])
+        assert (v["xla"] & sh.QA_QUARANTINED).sum() == 0
+
+    def test_identical_bitmasks_under_corruption(self):
+        cor = np.zeros(64, np.float32)
+        cor[[4, 17, 40]] = 1.0
+        out, mask = self._three_ways(corrupt=jnp.asarray(cor))
+        v = {k: np.asarray(d.health_verdicts) for k, (_, _, d) in
+             out.items()}
+        np.testing.assert_array_equal(v["xla"], v["rows"])
+        np.testing.assert_array_equal(v["xla"], v["kernel"])
+        observed = mask.any(axis=0)
+        want = set(np.nonzero(cor.astype(bool) & observed)[0])
+        assert set(np.nonzero(v["xla"] & sh.QA_QUARANTINED)[0]) == want
+        for name, (x, a, d) in out.items():
+            assert np.isfinite(np.asarray(x)).all(), name
+            assert np.isfinite(np.asarray(a)).all(), name
+            assert int(d.quarantined_count) == len(want), name
+
+    def test_quarantined_pixels_forecast_valued_deflated_info(self):
+        op, obs, x_f, p_inv, bounds, mask = self._quad()
+        cor = np.zeros(64, np.float32)
+        cor[5] = 1.0
+        x, a, d = iterated_solve(
+            op.linearize, obs, x_f, p_inv, state_bounds=bounds,
+            corrupt=jnp.asarray(cor),
+        )
+        np.testing.assert_array_equal(np.asarray(x)[5],
+                                      np.asarray(x_f)[5])
+        np.testing.assert_allclose(
+            np.asarray(a)[5],
+            sh.QUARANTINE_INFO_SCALE * np.asarray(p_inv)[5],
+            rtol=1e-6,
+        )
+        # zeroed diagnostics for the quarantined pixel
+        assert (np.asarray(d.innovations)[:, 5] == 0).all()
+        assert (np.asarray(d.fwd_modelled)[:, 5] == 0).all()
+
+    def test_healthy_pixels_bit_identical_under_corruption_xla(self):
+        op, obs, x_f, p_inv, bounds, mask = self._quad()
+        cor = np.zeros(64, np.float32)
+        cor[[4, 17]] = 1.0
+        x0, a0, d0 = iterated_solve(
+            op.linearize, obs, x_f, p_inv, state_bounds=bounds,
+        )
+        x1, a1, d1 = iterated_solve(
+            op.linearize, obs, x_f, p_inv, state_bounds=bounds,
+            corrupt=jnp.asarray(cor),
+        )
+        assert int(d0.n_iterations) == int(d1.n_iterations)
+        healthy = np.ones(64, bool)
+        healthy[[4, 17]] = False
+        np.testing.assert_array_equal(
+            np.asarray(x1)[healthy], np.asarray(x0)[healthy]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a1)[healthy], np.asarray(a0)[healthy]
+        )
+
+
+# ---------------------------------------------------------------------------
+# damping escalation: recoverable pixels recover (and say so)
+# ---------------------------------------------------------------------------
+
+class TestDampedRecovery:
+    def _singular_problem(self, n=16, bad_pixel=6):
+        """Identity-like operator observing ONLY parameter 0, with one
+        pixel's prior information row zeroed: that pixel's A has an
+        exactly-zero diagonal entry — Cholesky breakdown on iteration
+        1, recoverable by the LM diagonal floor."""
+        p, n_bands = 2, 1
+        jac = np.zeros((n_bands, n, p), np.float32)
+        jac[0, :, 0] = 1.0
+        h0 = np.zeros((n_bands, n), np.float32)
+        y = RNG.uniform(0.4, 0.6, size=(n_bands, n)).astype(np.float32)
+        mask = np.ones((n_bands, n), bool)
+        r_inv = np.full((n_bands, n), 25.0, np.float32)
+        p_inv = np.broadcast_to(
+            4.0 * np.eye(p, dtype=np.float32), (n, p, p)
+        ).copy()
+        p_inv[bad_pixel] = 0.0
+        p_inv[bad_pixel, 0, 0] = 4.0
+        obs = BandBatch(y=jnp.asarray(y), r_inv=jnp.asarray(r_inv),
+                        mask=jnp.asarray(mask))
+        lin = lambda x: Linearization(
+            h0=jnp.einsum("bnp,np->bn", jnp.asarray(jac), x),
+            jac=jnp.asarray(jac),
+        )
+        return lin, obs, jnp.full((n, p), 0.5, jnp.float32), \
+            jnp.asarray(p_inv)
+
+    def test_singular_prior_pixel_recovers_with_verdict(self):
+        lin, obs, x_f, p_inv = self._singular_problem()
+        x, a, d = iterated_solve(lin, obs, x_f, p_inv)
+        v = np.asarray(d.health_verdicts)
+        assert v[6] & sh.QA_DAMPED_RECOVERED, v[6]
+        assert not v[6] & sh.QA_QUARANTINED
+        assert int(d.damped_recovered_count) == 1
+        assert int(d.quarantined_count) == 0
+        assert np.isfinite(np.asarray(x)).all()
+        # every other pixel is plainly converged
+        others = np.ones(16, bool)
+        others[6] = False
+        assert (v[others] == sh.QA_CONVERGED).all()
+
+
+# ---------------------------------------------------------------------------
+# edge-case regressions through both kalman_update paths (satellite)
+# ---------------------------------------------------------------------------
+
+class TestEdgeCases:
+    def _both_updates(self, lin, obs, x_lin, x_f, p_inv):
+        x_xla, a_xla = kalman_update(lin, obs, x_lin, x_f, p_inv)
+        x_pal, a_pal = kalman_update(
+            lin, obs, x_lin, x_f, p_inv, use_pallas=True
+        )
+        return (x_xla, a_xla), (x_pal, a_pal)
+
+    def test_zero_valid_observation_window(self):
+        """All-masked window: the update is prior-only — x equals the
+        forecast (up to factor round-off) through BOTH paths, and the
+        iterated solve verdicts every pixel NODATA."""
+        _, obs, x_f, p_inv, _ = _problem(mask_frac=1.1)
+        assert not np.asarray(obs.mask).any()
+        h0 = jnp.zeros_like(obs.y)
+        jac = jnp.zeros(obs.y.shape + (x_f.shape[-1],), jnp.float32)
+        lin = Linearization(h0=h0, jac=jac)
+        for x, a in self._both_updates(lin, obs, x_f, x_f, p_inv):
+            np.testing.assert_allclose(
+                np.asarray(x), np.asarray(x_f), rtol=1e-5, atol=1e-5
+            )
+            assert np.isfinite(np.asarray(x)).all()
+        _, _, d = iterated_solve(lambda x: lin, obs, x_f, p_inv)
+        assert (np.asarray(d.health_verdicts) == sh.QA_NODATA).all()
+        assert int(d.quarantined_count) == 0
+
+    def test_all_nan_nodata_pixel_stays_inert(self):
+        """One pixel masked (NaN y) in EVERY band: its posterior is its
+        forecast, no NaN leaks into neighbours, verdict NODATA — both
+        update paths."""
+        lin_fn, obs, x_f, p_inv, mask = _problem(mask_frac=0.0)
+        y = np.asarray(obs.y).copy()
+        m = np.asarray(obs.mask).copy()
+        r = np.asarray(obs.r_inv).copy()
+        y[:, 7] = np.nan
+        m[:, 7] = False
+        r[:, 7] = 0.0
+        obs = BandBatch(y=jnp.asarray(y), r_inv=jnp.asarray(r),
+                        mask=jnp.asarray(m))
+        lin = lin_fn(x_f)
+        for x, a in self._both_updates(lin, obs, x_f, x_f, p_inv):
+            x = np.asarray(x)
+            assert np.isfinite(x).all()
+        _, _, d = iterated_solve(lin_fn, obs, x_f, p_inv)
+        v = np.asarray(d.health_verdicts)
+        assert v[7] == sh.QA_NODATA
+        assert (v[np.arange(48) != 7] != sh.QA_NODATA).all()
+
+    def test_singular_prior_raw_update_nan_is_local(self):
+        """Regression pin of the RAW single-update behavior both paths
+        share: a singular system NaNs ONLY its own pixel (per-pixel
+        factorisation — no cross-pixel contamination), which is exactly
+        the failure the iterated solve's health layer detects and
+        contains (TestDampedRecovery)."""
+        n, p = 12, 2
+        jac = np.zeros((1, n, p), np.float32)
+        jac[0, :, 0] = 1.0
+        lin = Linearization(
+            h0=jnp.zeros((1, n), jnp.float32), jac=jnp.asarray(jac)
+        )
+        obs = BandBatch(
+            y=jnp.full((1, n), 0.5, jnp.float32),
+            r_inv=jnp.full((1, n), 25.0, jnp.float32),
+            mask=jnp.ones((1, n), bool),
+        )
+        p_inv = np.broadcast_to(
+            4.0 * np.eye(p, dtype=np.float32), (n, p, p)
+        ).copy()
+        p_inv[4] = 0.0
+        p_inv[4, 0, 0] = 4.0
+        x_f = jnp.full((n, p), 0.5, jnp.float32)
+        for x, a in self._both_updates(
+            lin, obs, x_f, x_f, jnp.asarray(p_inv)
+        ):
+            x = np.asarray(x)
+            bad = ~np.isfinite(x).all(axis=-1)
+            assert bad[4]
+            assert not bad[np.arange(n) != 4].any()
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: the full engine + GeoTIFF QA band story
+# ---------------------------------------------------------------------------
+
+def _engine_run(tmp_path, tag, scan_window, fault_spec=None):
+    from kafka_tpu.core import propagate_information_filter
+    from kafka_tpu.core.propagators import PixelPrior
+    from kafka_tpu.engine import FixedGaussianPrior, KalmanFilter
+    from kafka_tpu.io import GeoTIFFOutput
+    from kafka_tpu.obsops.identity import IdentityOperator
+    from kafka_tpu.testing import SyntheticObservations
+    from kafka_tpu.testing.fixtures import DEFAULT_GEO
+
+    faults.reset()
+    if fault_spec is not None:
+        faults.script("solver.pixel", fault_spec)
+    rng = np.random.default_rng(0)
+    mask = np.ones((6, 6), bool)
+    p = 2
+    op = IdentityOperator(n_params=p, obs_indices=(0, 1))
+    truth = rng.uniform(0.3, 0.7, mask.shape + (p,)).astype(np.float32)
+
+    def day(i):
+        return datetime.datetime(2021, 3, 1) + datetime.timedelta(days=i)
+
+    obs = SyntheticObservations(
+        dates=[day(i) for i in (1, 2, 3, 4)], operator=op,
+        truth_fn=lambda date: truth, sigma=0.02, seed=5, mask_prob=0.05,
+    )
+    mean = np.full((p,), 0.5, np.float32)
+    cov = np.diag(np.full((p,), 0.25)).astype(np.float32)
+    prior = FixedGaussianPrior(
+        PixelPrior(
+            mean=jnp.asarray(mean), cov=jnp.asarray(cov),
+            inv_cov=jnp.asarray(np.linalg.inv(cov)),
+        ),
+        ("a", "b"),
+    )
+    outdir = str(tmp_path / tag)
+    out = GeoTIFFOutput(("a", "b"), DEFAULT_GEO.geotransform,
+                        DEFAULT_GEO.projection, outdir,
+                        epsg=DEFAULT_GEO.epsg)
+    with telemetry.use(MetricsRegistry()) as reg:
+        kf = KalmanFilter(
+            obs, out, mask, ("a", "b"),
+            state_propagation=propagate_information_filter, prior=None,
+            pad_multiple=16, prefetch_depth=0, scan_window=scan_window,
+        )
+        kf.set_trajectory_model()
+        kf.set_trajectory_uncertainty(np.full(p, 1e-3, np.float32))
+        x0, p_inv0 = prior.process_prior(None, kf.gather)
+        kf.run([day(i) for i in range(0, 6)], x0, None, p_inv0)
+    faults.reset()
+    return kf, reg, outdir
+
+
+def _read(outdir, name):
+    from kafka_tpu.io import read_geotiff
+
+    arr, _ = read_geotiff(os.path.join(outdir, name))
+    return np.asarray(arr)
+
+
+class TestChaosAcceptance:
+    """The acceptance scenario, unfused and fused: k deliberately-
+    divergent pixels, exactly k quarantined in the QA band, healthy
+    pixels bit-identical, device-read invariant intact."""
+
+    BAD = [3, 4, 5]  # armed pixel indices (0-based, gather order)
+
+    def _coords(self):
+        rows, cols = np.nonzero(np.ones((6, 6), bool))
+        return rows[self.BAD], cols[self.BAD]
+
+    @pytest.mark.parametrize("scan_window", [1, 4])
+    def test_quarantine_qa_band_and_healthy_parity(self, tmp_path,
+                                                   scan_window):
+        kf_c, reg_c, dir_c = _engine_run(tmp_path, f"c{scan_window}",
+                                         scan_window)
+        kf_f, reg_f, dir_f = _engine_run(tmp_path, f"f{scan_window}",
+                                         scan_window, "3-5")
+        # rc 0 — both runs completed; every window counted its verdicts.
+        assert all(r["quarantined"] == len(self.BAD)
+                   for r in kf_f.diagnostics_log)
+        assert all(r["quarantined"] == 0
+                   for r in kf_c.diagnostics_log)
+        assert reg_f.value(
+            "kafka_solver_quarantined_pixels_total"
+        ) == len(self.BAD) * len(kf_f.diagnostics_log)
+        # Zero added device reads, chaos or not: one packed read per
+        # dispatch (a fused block of k windows is one dispatch).
+        for kf, reg in ((kf_c, reg_c), (kf_f, reg_f)):
+            expected = sum(
+                1.0 / r.get("fused", 1) for r in kf.diagnostics_log
+            )
+            assert reg.value(
+                "kafka_engine_device_reads_total"
+            ) == expected
+        br, bc = self._coords()
+        healthy = np.ones((6, 6), bool)
+        healthy[br, bc] = False
+        qa_files = sorted(
+            f for f in os.listdir(dir_f) if f.startswith("solver_qa")
+        )
+        assert len(qa_files) == len(kf_f.diagnostics_log) if \
+            scan_window == 1 else len(qa_files) >= 1
+        for fn in qa_files:
+            qa = _read(dir_f, fn)
+            # exactly the armed pixels are quarantined
+            assert (qa[br, bc].astype(int) & sh.QA_QUARANTINED).all()
+            assert (qa[healthy].astype(int) & sh.QA_QUARANTINED).sum() \
+                == 0
+            # the clean run's QA band reports everything converged
+            qa_clean = _read(dir_c, fn)
+            assert (qa_clean[healthy].astype(int)
+                    & sh.QA_CONVERGED).all()
+        for fn in sorted(os.listdir(dir_c)):
+            if fn.startswith("solver_qa") or not fn.endswith(".tif"):
+                continue
+            a_clean = _read(dir_c, fn)
+            a_fault = _read(dir_f, fn)
+            if scan_window == 1:
+                # unfused: healthy pixels bit-identical
+                np.testing.assert_array_equal(
+                    a_fault[healthy], a_clean[healthy], err_msg=fn
+                )
+            else:
+                np.testing.assert_allclose(
+                    a_fault[healthy], a_clean[healthy], atol=2e-3,
+                    err_msg=fn,
+                )
+
+    def test_quarantined_outputs_forecast_valued_inflated_unc(
+            self, tmp_path):
+        """The quarantined pixels' product values ARE the forecast —
+        with no prior blend and an identity trajectory the forecast
+        never leaves the initial mean (0.5) — and their uncertainty is
+        INFLATED relative to the clean run's converged sigma."""
+        _, _, dir_c = _engine_run(tmp_path, "cv", 1)
+        _, _, dir_f = _engine_run(tmp_path, "fv", 1, "3-5")
+        br, bc = self._coords()
+        # only windows that actually assimilated carry a QA band (and a
+        # quarantine); the first grid window here is observation-less.
+        solved_dates = {
+            fn.split("_")[-1].replace(".tif", "")
+            for fn in os.listdir(dir_f) if fn.startswith("solver_qa")
+        }
+        checked = 0
+        for fn in sorted(os.listdir(dir_f)):
+            if not fn.endswith(".tif") or fn.startswith("solver_qa"):
+                continue
+            if not any(d in fn for d in solved_dates):
+                continue
+            checked += 1
+            vals = _read(dir_f, fn)[br, bc]
+            if fn.endswith("_unc.tif") or "_unc_" in fn:
+                clean = _read(dir_c, fn)[br, bc]
+                assert (vals > clean).all(), fn
+            else:
+                np.testing.assert_array_equal(
+                    vals, np.full(len(self.BAD), 0.5, np.float32),
+                    err_msg=fn,
+                )
+        assert checked >= 8  # 4 solved windows x 2 params x (val+unc)/2
+
+
+class TestRunSyntheticChaos:
+    def test_env_spec_reaches_the_driver(self, tmp_path, monkeypatch):
+        """KAFKA_TPU_FAULTS='solver.pixel@...' through the real driver:
+        run_synthetic completes rc 0 and writes QA bands with exactly
+        the armed pixels quarantined."""
+        from kafka_tpu.cli import run_synthetic
+
+        outdir = str(tmp_path / "out")
+        monkeypatch.setenv(faults.ENV_VAR, "solver.pixel@2-4")
+        argv = ["--operator", "identity", "--ny", "12", "--nx", "12",
+                "--days", "6", "--step", "2", "--obs-every", "2",
+                "--outdir", outdir]
+        summary = run_synthetic.main(argv)
+        faults.reset()
+        assert summary["n_pixels"] > 0
+        qa_files = [f for f in os.listdir(outdir)
+                    if f.startswith("solver_qa")]
+        assert qa_files
+        from kafka_tpu.io import read_geotiff
+        from kafka_tpu.testing.fixtures import make_pivot_mask
+
+        mask = make_pivot_mask(12, 12)
+        rows, cols = np.nonzero(mask)
+        qa, _ = read_geotiff(os.path.join(outdir, sorted(qa_files)[-1]))
+        qa = np.asarray(qa)
+        flagged = set(np.nonzero(
+            qa[rows, cols].astype(int) & sh.QA_QUARANTINED
+        )[0])
+        assert flagged == {2, 3, 4}

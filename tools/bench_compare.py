@@ -104,6 +104,40 @@ def compare_rows(old: dict, new: dict, threshold: float = 0.10,
     return regressions, lines
 
 
+def solver_health_deltas(old: dict, new: dict
+                         ) -> Tuple[List[str], List[str]]:
+    """(warnings, report_lines) over the embedded ``solver_health``
+    snapshots (bench.py's compact kafka_solver_* counter view).
+
+    Diffed INFORMATIONALLY like the telemetry snapshots — result
+    quality is a property of the data and the solver, not a timing gate
+    — with ONE exception loud enough to not scroll past: a NEW nonzero
+    ``quarantined_pixels`` count on a previously-clean benchmark is a
+    numerical-health break (pixels served as forecast fallbacks), so it
+    surfaces as an explicit warning.  Still exit 0: the verdict stays
+    with the human, but never silence.
+    """
+    h_old = old.get("solver_health") or {}
+    h_new = new.get("solver_health") or {}
+    warnings: List[str] = []
+    lines: List[str] = []
+    for key in sorted(set(h_old) | set(h_new)):
+        a, b = h_old.get(key, 0), h_new.get(key, 0)
+        if a == b == 0:
+            continue
+        lines.append(f"  {key}: {a:g} -> {b:g}")
+    old_quar = float(h_old.get("quarantined_pixels") or 0)
+    new_quar = float(h_new.get("quarantined_pixels") or 0)
+    if new_quar > 0 and old_quar == 0:
+        warnings.append(
+            f"quarantined_pixels went 0 -> {new_quar:g}: the new "
+            "artifact served forecast fallbacks on a previously-clean "
+            "benchmark (solve-health break, not a perf question) — "
+            "inspect the solver_qa bands before trusting its timings"
+        )
+    return warnings, lines
+
+
 def telemetry_deltas(old: dict, new: dict, top: int = 8) -> List[str]:
     """Largest relative changes between the embedded registry snapshots
     (context for a timing shift; never gated on)."""
@@ -155,6 +189,13 @@ def main(argv=None) -> int:
         print("telemetry deltas (context, not gated):")
         for line in deltas:
             print(line)
+    health_warnings, health_lines = solver_health_deltas(old, new)
+    if health_lines:
+        print("solver-health deltas (result quality, not gated):")
+        for line in health_lines:
+            print(line)
+    for w in health_warnings:
+        print(f"bench_compare: WARNING {w}", file=sys.stderr)
     unhealthy = [
         name for name, art in (("old", old), ("new", new))
         if art.get("unhealthy")

@@ -354,6 +354,75 @@ class KernelRelayout(Rule):
         return findings
 
 
+#: the one module allowed to select non-finite values away in device
+#: code — every replacement there is paired with a solve-health verdict
+#: (retreat flags feed escalation; quarantine selects set QA bits).
+NONFINITE_SANCTUARY = "kafka_tpu/core/solver_health.py"
+
+_NONFINITE_PROBES = {"isnan", "isfinite", "isinf"}
+
+
+@register
+class NonfiniteLaunder(Rule):
+    name = "nonfinite-launder"
+    description = (
+        "jnp.nan_to_num, or jnp.where whose condition probes "
+        "isnan/isfinite/isinf, outside core/solver_health.py — "
+        "replacing a non-finite value with a plausible number without "
+        "raising a solve-health verdict is exactly the silent per-pixel "
+        "divergence the health layer exists to end; detect through "
+        "solver_health helpers so the replacement carries a QA bit"
+    )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None or ctx.rel == NONFINITE_SANCTUARY:
+            return ()
+        jnp_names = jitscan.jnp_aliases(ctx.tree)
+        if not jnp_names:
+            return ()
+        findings: List[Finding] = []
+
+        def probes_nonfinite(node: ast.AST) -> bool:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and isinstance(
+                        sub.func, ast.Attribute) and \
+                        sub.func.attr in _NONFINITE_PROBES:
+                    return True
+            return False
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in jnp_names):
+                continue
+            if f.attr == "nan_to_num":
+                findings.append(Finding(
+                    path=ctx.rel, line=node.lineno, rule=self.name,
+                    message=(
+                        f"{f.value.id}.nan_to_num() launders NaN/inf "
+                        "into plausible numbers with no verdict — "
+                        "route the replacement through "
+                        "core/solver_health.py so the pixel is flagged"
+                    ),
+                ))
+            elif f.attr == "where" and node.args and \
+                    probes_nonfinite(node.args[0]):
+                findings.append(Finding(
+                    path=ctx.rel, line=node.lineno, rule=self.name,
+                    message=(
+                        f"{f.value.id}.where() on an isnan/isfinite "
+                        "probe silently launders non-finite values — "
+                        "use the sanctioned solver_health selects "
+                        "(retreat/quarantine_select), which pair every "
+                        "replacement with a QA verdict"
+                    ),
+                ))
+        return findings
+
+
 def _flag_kind(param: ast.arg, default) -> str:
     """'bool'/'str' when the parameter is annotated or defaulted as such."""
     ann = param.annotation

@@ -96,6 +96,7 @@ def run_load(
     (that IS the response — fast rejection is the overload contract);
     everything else waits for its response file."""
     results = []
+    health_totals: dict = {}
     lock = threading.Lock()
     it = iter(list(enumerate(requests)))
 
@@ -118,8 +119,12 @@ def run_load(
                                 timeout_s=timeout_s)
             wall_ms = (time.perf_counter() - t0) * 1e3
             status = "timeout" if got is None else got.get("status", "?")
+            health = (got or {}).get("solver_health") or {}
             with lock:
                 results.append((status, None, wall_ms))
+                for key, v in health.items():
+                    health_totals[key] = health_totals.get(key, 0) + \
+                        int(v or 0)
 
     threads = [
         # kafkalint: disable=untracked-thread — loadgen threads are the
@@ -148,6 +153,14 @@ def run_load(
         "serve_error_total": count("error") + count("timeout"),
         "serve_rps": round(n_ok / wall_s, 2) if wall_s > 0 else None,
         "serve_wall_s": round(wall_s, 3),
+        # Result QUALITY rows, summed over answered requests from the
+        # per-response solver_health blocks: latency numbers alone would
+        # hide a service answering fast with quarantined pixels.
+        "serve_quarantined_pixels": health_totals.get("quarantined", 0),
+        "serve_cap_bailouts": health_totals.get("cap_bailouts", 0),
+        "serve_damped_recovered": health_totals.get(
+            "damped_recovered", 0
+        ),
     }
 
 
