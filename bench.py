@@ -424,6 +424,12 @@ def assemble_result(
         else serve.get("serve_rejected_total"),
         "serve_requests_total": None if serve is None
         else serve.get("serve_requests_total"),
+        # Mid-run /metrics scrape of the serving bench (tools/loadgen's
+        # _MetricsScraper against the ephemeral telemetry.httpd
+        # endpoint): how queue depth and admission counters MOVED under
+        # load, diffed informationally by tools/bench_compare.py.
+        "live_telemetry": None if serve is None
+        else serve.get("live_telemetry"),
         # Bench health layer (see telemetry.health.probe_health): off-band
         # probes flag the whole artifact so cross-round consumers discard
         # it instead of reading environment weather as a perf change.

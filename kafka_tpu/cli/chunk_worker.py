@@ -56,6 +56,10 @@ def _queue_main(argv) -> int:
     ap.add_argument("--num-workers", type=int, default=1,
                     help="local fleet size (N>1 spawns N single-worker "
                          "subprocesses of this command and waits)")
+    ap.add_argument("--http-port", type=int, default=0,
+                    help="live metrics endpoint port (/metrics /healthz "
+                         "/statusz; 0 = disabled; a local fleet gives "
+                         "worker i port+i)")
     args = ap.parse_args(argv)
 
     if args.num_workers > 1:
@@ -66,8 +70,13 @@ def _queue_main(argv) -> int:
         env = dict(os.environ)
         # All workers join one trace: new_run_id() picks this up.
         env.setdefault("KAFKA_TPU_RUN_ID", os.urandom(6).hex())
-        procs = [subprocess.Popen(cmd, env=env)
-                 for _ in range(args.num_workers)]
+        procs = []
+        for i in range(args.num_workers):
+            worker_cmd = list(cmd)
+            if args.http_port:
+                # One endpoint per worker process.
+                worker_cmd += ["--http-port", str(args.http_port + i)]
+            procs.append(subprocess.Popen(worker_cmd, env=env))
         rcs = [p.wait() for p in procs]
         hard = [rc for rc in rcs if rc not in (0, 75)]
         if hard:
@@ -75,13 +84,19 @@ def _queue_main(argv) -> int:
         return 75 if 75 in rcs else 0
 
     from ..engine.config import RunConfig
+    from ..telemetry.httpd import maybe_start
     from .drivers import resolve_aux_builder, run_config
 
     cfg = RunConfig.load(args.config)
-    stats = run_config(
-        cfg, resolve_aux_builder(cfg), queue=True,
-        lease_ttl_s=args.lease_ttl_s,
-    )
+    httpd = maybe_start(args.http_port, role="queue_worker")
+    try:
+        stats = run_config(
+            cfg, resolve_aux_builder(cfg), queue=True,
+            lease_ttl_s=args.lease_ttl_s,
+        )
+    finally:
+        if httpd is not None:
+            httpd.close()
     print(json.dumps(stats))
     if stats.get("failed"):
         from ..resilience import EXIT_PARTIAL_SUCCESS
@@ -99,7 +114,7 @@ def main(argv=None) -> int:
     from ..engine.config import RunConfig
     from ..io.tiling import Chunk
     from ..telemetry import (
-        configure, flight_recorder, get_registry,
+        configure, flight_recorder, get_registry, live,
         install_compile_listeners, tracing,
     )
     from .drivers import (
@@ -131,6 +146,7 @@ def main(argv=None) -> int:
     # new_run_id() picks up KAFKA_TPU_RUN_ID from the parent scheduler,
     # so this worker's spans and crash dumps correlate with its trace.
     with tracing.push(run_id=tracing.new_run_id(), chunk_id=prefix):
+        live.start_publisher(role="chunk_worker")
         try:
             with recorder:
                 summary = run_one_chunk(
@@ -142,6 +158,8 @@ def main(argv=None) -> int:
                 print(str(exc)[:500], file=sys.stderr)
                 return OOM_EXIT_CODE
             raise
+        finally:
+            live.stop_publisher()
     get_registry().dump()
     print(json.dumps(summary))
     return 0

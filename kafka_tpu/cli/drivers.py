@@ -504,7 +504,7 @@ def run_config(
     needs no assignment (BASELINE.md "Multi-host queue")."""
     from ..resilience import RetryPolicy, faults
     from ..telemetry import (
-        configure, flight_recorder, get_registry,
+        configure, flight_recorder, get_registry, live,
         install_compile_listeners, tracing,
     )
     from ..utils.compilation_cache import enable_compilation_cache
@@ -555,30 +555,40 @@ def run_config(
     # One trace context for the whole run: chunk/window ids are pushed
     # below it, and the recorder guard dumps on the way out of a failure.
     with tracing.push(run_id=tracing.new_run_id()), recorder:
-        if queue:
-            from ..shard.queue import DEFAULT_LEASE_TTL_S, run_queue
+        # Fleet plane heartbeat: live_<host>_<pid>.json refreshed in the
+        # background for operators watching mid-run (no-op without a
+        # telemetry dir; the stop writes the clean-shutdown snapshot).
+        live.start_publisher(role="queue_worker" if queue else "engine")
+        try:
+            if queue:
+                from ..shard.queue import DEFAULT_LEASE_TTL_S, run_queue
 
-            stats = run_queue(
-                chunks, run_one, cfg.output_folder,
-                lease_ttl_s=(lease_ttl_s if lease_ttl_s
-                             else DEFAULT_LEASE_TTL_S),
-                retry_policy=retry_policy,
-                quarantine=bool(ft.get("quarantine", True)),
-                chunk_deadline_s=(
-                    float(deadline_s) if deadline_s is not None else None
-                ),
-                max_requeues=ft.get("max_requeues"),
-            )
-        else:
-            stats = run_chunks(
-                chunks, run_one, cfg.output_folder,
-                num_processes=num_processes, process_index=process_index,
-                retry_policy=retry_policy,
-                quarantine=bool(ft.get("quarantine", False)),
-                chunk_deadline_s=(
-                    float(deadline_s) if deadline_s is not None else None
-                ),
-            )
+                stats = run_queue(
+                    chunks, run_one, cfg.output_folder,
+                    lease_ttl_s=(lease_ttl_s if lease_ttl_s
+                                 else DEFAULT_LEASE_TTL_S),
+                    retry_policy=retry_policy,
+                    quarantine=bool(ft.get("quarantine", True)),
+                    chunk_deadline_s=(
+                        float(deadline_s) if deadline_s is not None
+                        else None
+                    ),
+                    max_requeues=ft.get("max_requeues"),
+                )
+            else:
+                stats = run_chunks(
+                    chunks, run_one, cfg.output_folder,
+                    num_processes=num_processes,
+                    process_index=process_index,
+                    retry_policy=retry_policy,
+                    quarantine=bool(ft.get("quarantine", False)),
+                    chunk_deadline_s=(
+                        float(deadline_s) if deadline_s is not None
+                        else None
+                    ),
+                )
+        finally:
+            live.stop_publisher()
     stats["chunks_with_pixels"] = len(summaries)
     stats["pixels"] = int(
         sum(s["n_pixels"] for s in summaries.values())

@@ -91,6 +91,22 @@ def build_parser() -> argparse.ArgumentParser:
                          "unbounded telemetry)")
     ap.add_argument("--events-keep", type=int, default=3,
                     help="rotated events.jsonl segments kept")
+    ap.add_argument("--http-port", type=int, default=0,
+                    help="live metrics endpoint port (/metrics "
+                         "Prometheus text, /healthz, /statusz with "
+                         "sessions + queue depth + crash index; "
+                         "0 = disabled)")
+    ap.add_argument("--live-interval-s", type=float, default=None,
+                    help="live_<host>_<pid>.json heartbeat cadence "
+                         "(default 2s or $KAFKA_TPU_LIVE_INTERVAL_S)")
+    ap.add_argument("--fleet-dir", default=None,
+                    help="telemetry root holding the fleet's live "
+                         "snapshots; the daemon refreshes the "
+                         "kafka_fleet_dead_hosts gauge from it")
+    ap.add_argument("--max-dead-hosts", type=int, default=None,
+                    help="shed requests (reason fleet_degraded) while "
+                         "the fleet view counts more dead hosts than "
+                         "this (needs --fleet-dir)")
     add_telemetry_arg(ap)
     ap.add_argument("--verbose", action="store_true")
     return ap
@@ -147,6 +163,7 @@ def main(argv=None):
             else None
         ),
         shed_when_unhealthy=not args.no_shed_unhealthy,
+        max_dead_hosts=args.max_dead_hosts,
     )
     service = AssimilationService(
         sessions, args.root, policy=policy,
@@ -157,13 +174,46 @@ def main(argv=None):
         poll_interval_s=args.poll_interval_s,
         exit_when_idle=args.exit_when_idle,
         idle_grace_s=args.idle_grace_s,
+        fleet_dir=args.fleet_dir,
     )
-    with tracing.push(run_id=tracing.new_run_id()), recorder:
-        summary = daemon.run()
+
+    def statusz():
+        # The /statusz page's daemon-specific facts (read-only; handler
+        # threads must never block on the serve path).
+        return {
+            "serve_root": os.path.abspath(args.root),
+            "sessions": {
+                name: {"serves": sess.serves}
+                for name, sess in service.sessions.items()
+            },
+            "queue_depth": service.pending(),
+            "draining": service.draining,
+            "fleet_dir": args.fleet_dir,
+        }
+
+    from ..telemetry import live
+    from ..telemetry.httpd import maybe_start
+
     reg = get_registry()
+    with tracing.push(run_id=tracing.new_run_id()), recorder:
+        # Fleet plane: heartbeat snapshots + the optional live HTTP
+        # endpoint, up for exactly as long as the daemon serves.
+        live.update_status(serve_root=os.path.abspath(args.root),
+                           tiles=sorted(sessions))
+        live.start_publisher(role="serve",
+                             interval_s=args.live_interval_s)
+        httpd = maybe_start(args.http_port, status_provider=statusz,
+                            role="serve")
+        try:
+            summary = daemon.run()
+        finally:
+            live.stop_publisher()
+            if httpd is not None:
+                httpd.close()
     # Request-level errors completed the run but lost work — surface the
     # partial-success exit code the other drivers use.
     summary["failed"] = summary["errors"]
+    summary["http_port"] = None if httpd is None else httpd.port
     summary["telemetry_dir"] = reg.dump()
     print(json.dumps(summary))
     return summary

@@ -153,6 +153,10 @@ def main(argv=None):
     ap.add_argument("--max-degraded-dates", type=int, default=8,
                     help="degraded-date budget per filter run before "
                          "aborting")
+    ap.add_argument("--http-port", type=int, default=0,
+                    help="live metrics endpoint port (/metrics /healthz "
+                         "/statusz; 0 = disabled; fleet mode gives "
+                         "worker i port+i)")
     add_telemetry_arg(ap)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
@@ -168,14 +172,16 @@ def main(argv=None):
         # the one shared filesystem queue.
         return _run_fleet(args, raw_argv)
     from ..telemetry import (
-        configure, flight_recorder, get_registry,
+        configure, flight_recorder, get_registry, live,
         install_compile_listeners, tracing,
     )
+    from ..telemetry.httpd import maybe_start
 
     install_compile_listeners()
     if args.telemetry_dir:
         configure(args.telemetry_dir)
     recorder = flight_recorder.install(args.telemetry_dir)
+    httpd = maybe_start(args.http_port, role="engine")
     from ..resilience import RetryPolicy, faults
 
     # Chaos hook: KAFKA_TPU_FAULTS scripts deterministic failures at the
@@ -215,16 +221,26 @@ def main(argv=None):
     # One trace context for the run; the recorder guard turns a mid-run
     # death into a crash_<ts>.json next to the other telemetry artifacts.
     with tracing.push(run_id=tracing.new_run_id()), recorder:
-        if args.chunk_size > 0:
-            summary = _run_chunked(
-                args, mask, geo, op, params, prior, truth, aux_fn,
-                sigma, obs_dates, time_grid, read_policy,
-            )
-        else:
-            summary = _run_single(
-                args, mask, geo, op, params, prior, truth, aux_fn,
-                sigma, obs_dates, time_grid, read_policy,
-            )
+        # Fleet-plane heartbeat (live_<host>_<pid>.json; no-op without
+        # --telemetry-dir).  The queue chaos tests watch these files.
+        live.start_publisher(
+            role="queue_worker" if args.queue else "engine"
+        )
+        try:
+            if args.chunk_size > 0:
+                summary = _run_chunked(
+                    args, mask, geo, op, params, prior, truth, aux_fn,
+                    sigma, obs_dates, time_grid, read_policy,
+                )
+            else:
+                summary = _run_single(
+                    args, mask, geo, op, params, prior, truth, aux_fn,
+                    sigma, obs_dates, time_grid, read_policy,
+                )
+        finally:
+            live.stop_publisher()
+            if httpd is not None:
+                httpd.close()
     wall = time.time() - t0
 
     summary["operator"] = args.operator
@@ -406,7 +422,7 @@ def _run_fleet(args, raw_argv) -> dict:
     from ..shard.queue import queue_status
 
     child_argv = raw_argv
-    for flag in ("--num-workers", "--telemetry-dir"):
+    for flag in ("--num-workers", "--telemetry-dir", "--http-port"):
         child_argv = _strip_flag(child_argv, flag)
     env = dict(os.environ)
     # One run id for the whole fleet: every worker's spans/events join
@@ -419,6 +435,9 @@ def _run_fleet(args, raw_argv) -> dict:
         if args.telemetry_dir:
             cmd += ["--telemetry-dir",
                     os.path.join(args.telemetry_dir, f"worker_{i}")]
+        if args.http_port:
+            # One endpoint per worker process: ports cannot be shared.
+            cmd += ["--http-port", str(args.http_port + i)]
         procs.append(subprocess.Popen(cmd, env=env,
                                       stdout=subprocess.DEVNULL))
     rcs = [p.wait() for p in procs]

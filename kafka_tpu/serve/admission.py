@@ -24,6 +24,12 @@ Prometheus export read:
 ``kafka_health_unhealthy``      the latest ``probe_health`` verdict —
                                 an off-band host serves garbage
                                 latency, so shedding beats queueing
+``kafka_fleet_dead_hosts``      dead workers in the fleet view (the
+                                daemon refreshes it from the live
+                                snapshots, ``telemetry.aggregate``) —
+                                a degraded fleet sheds load instead of
+                                queueing work the dead capacity was
+                                meant to absorb
 =============================== =====================================
 
 Every decision is explicit: admitted requests count into
@@ -55,6 +61,10 @@ class AdmissionPolicy:
     max_prefetch_queue_depth: Optional[int] = 256
     max_writer_backlog: Optional[int] = 256
     shed_when_unhealthy: bool = True
+    #: shed (reason ``fleet_degraded``) while the fleet view counts more
+    #: dead hosts than this; None disables the signal (the default — it
+    #: only means something when the daemon refreshes the fleet gauge).
+    max_dead_hosts: Optional[int] = None
 
 
 class AdmissionController:
@@ -83,4 +93,8 @@ class AdmissionController:
             unhealthy = reg.value("kafka_health_unhealthy")
             if unhealthy:
                 return "unhealthy"
+        if pol.max_dead_hosts is not None:
+            dead = reg.value("kafka_fleet_dead_hosts")
+            if dead is not None and dead > pol.max_dead_hosts:
+                return "fleet_degraded"
         return None

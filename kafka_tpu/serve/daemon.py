@@ -27,6 +27,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import socket
 import threading
 import time
 from typing import Optional
@@ -123,6 +124,9 @@ class ServeDaemon:
         poll_interval_s: float = 0.05,
         exit_when_idle: bool = False,
         idle_grace_s: float = 1.0,
+        fleet_dir: Optional[str] = None,
+        fleet_refresh_s: float = 5.0,
+        fleet_ttl_s: Optional[float] = None,
     ):
         self.service = service
         self.root = root
@@ -131,7 +135,50 @@ class ServeDaemon:
         self.poll_interval_s = float(poll_interval_s)
         self.exit_when_idle = bool(exit_when_idle)
         self.idle_grace_s = float(idle_grace_s)
+        #: fleet awareness (optional): a telemetry root holding the
+        #: workers' live_<host>_<pid>.json heartbeats.  The daemon
+        #: refreshes kafka_fleet_dead_hosts from it so admission can
+        #: shed when the fleet degrades (AdmissionPolicy.max_dead_hosts).
+        self.fleet_dir = fleet_dir
+        self.fleet_refresh_s = float(fleet_refresh_s)
+        self.fleet_ttl_s = fleet_ttl_s
+        self._fleet_next = 0.0
         self._drain = threading.Event()
+
+    def _refresh_fleet_gauge(self) -> None:
+        """Read the live snapshots under ``fleet_dir`` and publish the
+        dead-host count as the admission gauge.  Runs inline on the poll
+        loop (bounded: a directory walk + a few json.loads), throttled
+        to ``fleet_refresh_s``."""
+        if not self.fleet_dir:
+            return
+        now = time.monotonic()
+        if now < self._fleet_next:
+            return
+        self._fleet_next = now + self.fleet_refresh_s
+        from ..telemetry.aggregate import (
+            load_live_snapshots, worker_liveness,
+        )
+
+        me = f"{socket.gethostname()}:{os.getpid()}"
+        liveness = worker_liveness(
+            load_live_snapshots(self.fleet_dir), ttl_s=self.fleet_ttl_s,
+        )
+        dead = sorted(
+            key for key, w in liveness.items()
+            if w["dead"] and key != me
+        )
+        reg = get_registry()
+        gauge = reg.gauge(
+            "kafka_fleet_dead_hosts",
+            "workers whose live-snapshot heartbeat went stale without a "
+            "clean-shutdown marker (the fleet view's dead-host count; "
+            "admission sheds on it via max_dead_hosts)",
+        )
+        prev = gauge.value()
+        gauge.set(len(dead))
+        if len(dead) != (prev or 0) and (dead or prev):
+            reg.emit("fleet_dead_hosts_changed", dead=dead)
 
     def drain(self) -> None:
         """Programmatic SIGTERM equivalent."""
@@ -188,6 +235,7 @@ class ServeDaemon:
         idle_since: Optional[float] = None
         try:
             while not self._drain.is_set():
+                self._refresh_fleet_gauge()
                 consumed = self._scan_inbox()
                 if consumed == 0 and self.service.pending() == 0:
                     if self.exit_when_idle:

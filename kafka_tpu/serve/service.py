@@ -218,6 +218,12 @@ class AssimilationService:
         with self._cond:
             return len(self._queue) + (1 if self._busy else 0)
 
+    @property
+    def draining(self) -> bool:
+        """True once new submissions are being rejected (the /statusz
+        surface; the internal event stays private)."""
+        return self._draining.is_set()
+
     # -- submission -----------------------------------------------------
 
     def submit(self, payload: dict) -> dict:

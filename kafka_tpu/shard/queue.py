@@ -71,6 +71,7 @@ from ..resilience import (
     faults,
 )
 from ..telemetry import get_registry, tracing
+from ..telemetry import live as live_telemetry
 from .scheduler import (
     _write_marker,
     chunk_metrics,
@@ -463,6 +464,14 @@ def run_queue(
     sweep_stale_tmp(outdir)
     write_manifest(outdir, chunks)
     owner = worker_id or default_worker_id()
+    # Queue-state export into the fleet plane: the live heartbeat
+    # snapshot names the queue this worker serves, so
+    # tools/fleet_status.py folds lease/chunk counts in with no extra
+    # configuration, and liveness joins on the same host:pid worker id.
+    live_telemetry.update_status(
+        queue_outdir=os.path.abspath(outdir), worker_id=owner,
+        lease_ttl_s=lease_ttl_s,
+    )
     by_prefix = {chunk_prefix(c): c for c in chunks}
     prefixes = list(by_prefix)
     # Stable per-worker rotation: workers start their claim scan at

@@ -21,14 +21,23 @@ scheduler, the output writers, the CLI drivers and ``bench.py``:
   reads (zero-extra-transfer guarantee, counted) and the per-window
   device-memory watermark gauges;
 - :mod:`health` — the host/device health probes (grown out of bench.py),
-  readings sourced from the registry.
+  readings sourced from the registry;
+- :mod:`live` — the fleet plane's write side: a tracked background
+  publisher on every process writing a bounded ``live_<host>_<pid>.json``
+  heartbeat snapshot atomically into the telemetry dir;
+- :mod:`httpd` — the stdlib-only live HTTP endpoint (``/metrics``
+  Prometheus text, ``/healthz``, ``/statusz``; port 0 = disabled);
+- :mod:`aggregate` — the fleet plane's read side: live snapshots merged
+  into one fleet view (counters summed, gauges per-host, histograms
+  into fleet p50/p99, stale heartbeats flagged dead) and per-process
+  ``trace.json`` fragments stitched into one Chrome trace.
 
 See BASELINE.md "Observability" for metric names, label conventions, the
 event schema, and "Tracing & crash forensics" for the trace/crash
 artifacts.
 """
 
-from . import flight_recorder, tracing
+from . import flight_recorder, live, tracing
 from .compilemon import install_compile_listeners
 from .device import fetch_scalars, record_memory_watermark
 from .registry import (
@@ -47,6 +56,7 @@ __all__ = [
     "flight_recorder",
     "get_registry",
     "install_compile_listeners",
+    "live",
     "record_memory_watermark",
     "set_registry",
     "span",

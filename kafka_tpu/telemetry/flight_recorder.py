@@ -119,6 +119,13 @@ class FlightRecorder:
                 pass
             reg.emit("crash_dump", reason=reason, path=path)
             self._prune_dumps(directory)
+        # Refresh the live heartbeat snapshot so the fleet view points
+        # at this forensics file NOW, not one publish interval later —
+        # for a process about to die, "later" never comes.  Late import:
+        # live builds on the registry only, no cycle.
+        from .live import publish_now
+
+        publish_now()
         return path
 
     def _prune_dumps(self, directory: str) -> None:

@@ -1,0 +1,229 @@
+"""Stdlib-only live metrics HTTP endpoint (the fleet plane's pull side).
+
+The live snapshots (``telemetry.live``) cover fleets that share a
+filesystem; a Prometheus server, a load balancer's health check or a
+human with ``curl`` want HTTP.  This module is that surface with ZERO
+new dependencies — ``http.server`` from the stdlib, threaded, bound to
+loopback by default:
+
+``/metrics``
+    the registry's Prometheus text exposition (v0.0.4) — scrape a live
+    run instead of waiting for ``metrics.prom`` at exit.
+``/healthz``
+    the health verdict, backed by ``telemetry.health.probe_health``:
+    by default it reads the LAST probe verdict from the registry gauge
+    (cheap enough for a load balancer's 1 Hz check); ``/healthz?probe=1``
+    runs a fresh probe round inline.  200 when healthy or unprobed,
+    503 when the verdict is off-band.
+``/statusz``
+    one JSON page of process state: pid/host/uptime, TraceContext run
+    id, session/queue facts from the status provider, solver-health
+    counters, and the crash-dump index (which forensics file to read
+    when something already died).
+
+**Port 0 = disabled** at the CLI layer (:func:`maybe_start`): the
+endpoint is opt-in, a batch run should not open sockets.  The class
+itself treats port 0 as "any free port" (`.port` reports the bound
+one) — the form tests and embedded scrapers use.
+
+Handler threads serve READS of the registry only — no sockets out, no
+subprocesses (kafkalint rule 13 enforces this for the telemetry tree).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from . import tracing
+from .live import build_snapshot, crash_dump_index
+from .registry import MetricsRegistry, get_registry
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class TelemetryHTTPd:
+    """One process's live metrics endpoint.  ``port=0`` binds any free
+    port (read it back from ``.port``); use :func:`maybe_start` for the
+    CLI convention where 0 means disabled."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 status_provider: Optional[Callable[[], dict]] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 role: str = "engine"):
+        self.host = host
+        self.status_provider = status_provider
+        self.role = role
+        self._registry = registry
+        self._t0 = time.time()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                outer._handle(self)
+
+            def log_message(self, fmt, *args):
+                pass  # the registry counter is the access log
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = int(self._server.server_address[1])
+        # Cross-thread trace propagation (PR 3 convention): capture the
+        # constructing thread's context, re-install it on the worker.
+        self._ctx = tracing.current_context()
+        self._thread = threading.Thread(
+            target=self._serve, name="telemetry-httpd", daemon=True,
+        )
+
+    # -- lifecycle ------------------------------------------------------
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def _reg(self) -> MetricsRegistry:
+        return self._registry if self._registry is not None \
+            else get_registry()
+
+    def start(self) -> "TelemetryHTTPd":
+        self._thread.start()
+        self._reg().emit(
+            "httpd_started", host=self.host, port=self.port,
+            role=self.role,
+        )
+        return self
+
+    def _serve(self) -> None:
+        tracing.set_context(self._ctx)
+        tracing.set_lane("telemetry")
+        self._server.serve_forever(poll_interval=0.1)
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._thread.join(timeout=5.0)
+        self._server.server_close()
+
+    # -- request handling ----------------------------------------------
+
+    def _handle(self, req: BaseHTTPRequestHandler) -> None:
+        parsed = urlparse(req.path)
+        path = parsed.path.rstrip("/") or "/"
+        reg = self._reg()
+        reg.counter(
+            "kafka_httpd_requests_total",
+            "live-endpoint requests served, labelled by endpoint",
+        ).inc(endpoint=path)
+        try:
+            if path == "/metrics":
+                self._send(req, 200, reg.prom_text(),
+                           content_type=PROM_CONTENT_TYPE)
+            elif path == "/healthz":
+                self._healthz(req, reg, parse_qs(parsed.query))
+            elif path == "/statusz":
+                self._statusz(req, reg)
+            elif path == "/":
+                self._send_json(req, 200, {
+                    "endpoints": ["/metrics", "/healthz", "/statusz"],
+                })
+            else:
+                self._send_json(req, 404, {"error": f"no such endpoint "
+                                                    f"{path!r}"})
+        except BrokenPipeError:
+            pass  # client went away mid-response — nothing to answer
+        except Exception as exc:  # noqa: BLE001 — a handler bug must 500, not kill the serving thread
+            reg.emit("httpd_error", path=path, error=repr(exc)[:200])
+            try:
+                self._send_json(req, 500, {"error": repr(exc)[:200]})
+            except OSError:  # socket already torn down — response lost
+                pass
+
+    def _healthz(self, req, reg, query: Dict[str, list]) -> None:
+        verdict: Optional[dict] = None
+        if query.get("probe", ["0"])[0] in ("1", "true"):
+            from .health import probe_health
+
+            verdict = probe_health(retry_wait_s=0.0, registry=reg)
+            unhealthy: Optional[float] = float(verdict["unhealthy"])
+        else:
+            unhealthy = reg.value("kafka_health_unhealthy")
+        body = {
+            "ok": not unhealthy,
+            "verdict": ("unprobed" if unhealthy is None
+                        else "unhealthy" if unhealthy else "healthy"),
+            "probe_host_ms": reg.value("kafka_health_probe_host_ms"),
+            "probe_device_ms": reg.value("kafka_health_probe_device_ms"),
+        }
+        if verdict is not None:
+            body["unhealthy_reasons"] = verdict["unhealthy_reasons"]
+        self._send_json(req, 503 if unhealthy else 200, body)
+
+    def _run_context(self):
+        """The run's TraceContext, best source first: handler threads
+        don't inherit contextvars, and the endpoint may be constructed
+        before the driver pushes its run id — the live publisher
+        (started inside the push) then carries the authoritative one."""
+        ctx = tracing.current_context() or self._ctx
+        if ctx is None:
+            from .live import active_publisher
+
+            pub = active_publisher()
+            if pub is not None:
+                ctx = pub._ctx
+        return ctx
+
+    def _statusz(self, req, reg) -> None:
+        ctx = self._run_context()
+        solver = {
+            k: v for k, v in reg.flat().items()
+            if k.startswith("kafka_solver_")
+        }
+        status = {}
+        if self.status_provider is not None:
+            status = dict(self.status_provider() or {})
+        snap = build_snapshot(reg, role=self.role)
+        self._send_json(req, 200, {
+            "host": socket.gethostname(),
+            "pid": os.getpid(),
+            "role": self.role,
+            "uptime_s": round(time.time() - self._t0, 3),
+            "run_id": None if ctx is None else ctx.run_id,
+            "telemetry_dir": reg.directory,
+            "events_buffered": len(reg.events),
+            "metric_series": (len(snap["counters"]) + len(snap["gauges"])
+                              + len(snap["histograms"])),
+            "solver_health": solver,
+            "crash_dumps": crash_dump_index(reg.directory),
+            "status": status,
+        })
+
+    # -- response plumbing ---------------------------------------------
+
+    @staticmethod
+    def _send(req, code: int, body: str,
+              content_type: str = "text/plain; charset=utf-8") -> None:
+        payload = body.encode("utf-8")
+        req.send_response(code)
+        req.send_header("Content-Type", content_type)
+        req.send_header("Content-Length", str(len(payload)))
+        req.end_headers()
+        req.wfile.write(payload)
+
+    @classmethod
+    def _send_json(cls, req, code: int, body: dict) -> None:
+        cls._send(req, code, json.dumps(body, default=str, indent=2),
+                  content_type="application/json")
+
+
+def maybe_start(port: Optional[int], **kwargs) -> Optional[TelemetryHTTPd]:
+    """The CLI convention: ``--http-port 0`` (the default) means
+    DISABLED — a batch run must not open listening sockets unasked.
+    Any nonzero port starts the endpoint and returns it."""
+    if not port:
+        return None
+    return TelemetryHTTPd(port=int(port), **kwargs).start()

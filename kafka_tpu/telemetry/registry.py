@@ -59,10 +59,40 @@ def _label_key(labels: Dict[str, Any]) -> LabelKey:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
 
 
+def escape_label_value(value: str) -> str:
+    """Prometheus text-exposition label-value escaping (v0.0.4):
+    backslash, double-quote and newline must be escaped or the scraped
+    line is unparseable — chunk prefixes and error strings end up in
+    labels, so this is not theoretical."""
+    return (value.replace("\\", "\\\\")
+                 .replace('"', '\\"')
+                 .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    """HELP-line escaping: backslash and newline only (quotes are legal
+    in help text)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def format_value(value: float) -> str:
+    """One sample value in exposition form.  Python's ``{:g}`` renders
+    infinities as ``inf``, which Prometheus parsers reject — the format
+    spells them ``+Inf`` / ``-Inf`` (and ``NaN``)."""
+    v = float(value)
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    return f"{v:g}"
+
+
 def _label_text(key: LabelKey) -> str:
     if not key:
         return ""
-    return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+    return "{" + ",".join(
+        f'{k}="{escape_label_value(v)}"' for k, v in key
+    ) + "}"
 
 
 class _Metric:
@@ -292,6 +322,12 @@ class MetricsRegistry:
                             and math.isinf(v) else v)
                         for k, v in val.items() if k != "buckets"
                     })
+                    # Bucket state rides the snapshot so cross-process
+                    # consumers (telemetry.aggregate, live snapshots)
+                    # can merge histograms and derive fleet quantiles —
+                    # count/sum alone cannot reconstruct a p99.
+                    entry["le"] = list(m.buckets)
+                    entry["buckets"] = list(val["buckets"])
                 else:
                     entry["value"] = val
                 series.append(entry)
@@ -313,11 +349,19 @@ class MetricsRegistry:
         return out
 
     def prom_text(self) -> str:
-        """Prometheus text exposition format v0.0.4."""
+        """Prometheus text exposition format v0.0.4.
+
+        Histogram ``_bucket{le=}`` lines are CUMULATIVE (each bucket
+        counts every observation ``<= le``, the ``+Inf`` bucket equals
+        ``_count``) and every series carries ``_sum``/``_count`` —
+        scraped latency histograms work with ``histogram_quantile``.
+        Label values and HELP text are escaped, non-finite samples are
+        spelled ``+Inf``/``-Inf``/``NaN``; the round-trip is pinned by
+        the ``telemetry.aggregate.parse_prom_text`` tests."""
         lines: List[str] = []
         for m in self.metrics():
             if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
+                lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
             lines.append(f"# TYPE {m.name} {m.kind}")
             for key, val in m._series():
                 if m.kind == "histogram":
@@ -332,13 +376,16 @@ class MetricsRegistry:
                         f"{val['count']}"
                     )
                     lines.append(
-                        f"{m.name}_sum{_label_text(key)} {val['sum']:g}"
+                        f"{m.name}_sum{_label_text(key)} "
+                        f"{format_value(val['sum'])}"
                     )
                     lines.append(
                         f"{m.name}_count{_label_text(key)} {val['count']}"
                     )
                 else:
-                    lines.append(f"{m.name}{_label_text(key)} {val:g}")
+                    lines.append(
+                        f"{m.name}{_label_text(key)} {format_value(val)}"
+                    )
         return "\n".join(lines) + "\n"
 
     def dump(self, directory: Optional[str] = None) -> Optional[str]:

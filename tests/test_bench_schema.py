@@ -27,6 +27,7 @@ EXPECTED_KEYS = [
     "e2e_pixel_steps_per_s", "e2e_device_fraction", "e2e_n_pixels",
     "serve_p50_ms", "serve_p99_ms", "serve_cold_ms",
     "serve_rejected_total", "serve_requests_total",
+    "live_telemetry",
     "probe_device_ms", "probe_host_ms", "probe_retried",
     "unhealthy_reasons", "probe_host_after_ms", "unhealthy",
     "telemetry", "solver_health",
@@ -44,6 +45,11 @@ SERVE_ROWS = {
     "serve_rejected_total": 0, "serve_requests_total": 24,
     "serve_ok_total": 24, "serve_cancelled_total": 0,
     "serve_error_total": 0,
+    "live_telemetry": {
+        "scrape_url": "http://127.0.0.1:1/metrics", "samples": 3,
+        "scrape_errors": 0,
+        "series": {"kafka_serve_queue_depth": [0.0, 2.0, 0.0]},
+    },
 }
 
 
@@ -175,6 +181,17 @@ class TestBenchArtifactSchema:
         assert result["serve_p50_ms"] is None
         assert result["serve_p99_ms"] is None
         assert result["serve_rejected_total"] is None
+        assert result["live_telemetry"] is None
+
+    def test_live_telemetry_flows_through(self):
+        """The mid-run /metrics scrape series (tools/loadgen) lands
+        verbatim in the artifact for bench_compare's informational
+        diff."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            _, result = _assemble(reg)
+        assert result["live_telemetry"]["samples"] == 3
+        assert "kafka_serve_queue_depth" in \
+            result["live_telemetry"]["series"]
 
     def test_fused_lin_row_flows_through_on_tpu_artifacts(self):
         """When the TPU bench measures the in-kernel generation, its

@@ -1,0 +1,326 @@
+"""Fleet observability acceptance (ISSUE 10): multi-process trace
+stitching and the fleet chaos scenario.
+
+(1) Golden stitch: 2 subprocess queue workers + 1 serve daemon share
+    one ``KAFKA_TPU_RUN_ID``; their per-process ``trace.json``
+    fragments stitch into a single well-formed Chrome trace with >= 3
+    distinct process tracks.
+
+(2) Fleet chaos: a queue run with 2 subprocess workers plus a serve
+    daemon; one worker is SIGKILLed mid-chunk.  ``fleet_status --json``
+    flags the dead host within one heartbeat TTL while counters still
+    sum correctly (and the queue view shows 9/9 done), trace stitching
+    produces one well-formed Chrome trace for the run id, and the
+    daemon's live ``/metrics`` output parses as valid Prometheus text
+    exposition.
+
+All tier-1 / CPU.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from kafka_tpu.io.tiling import chunk_mask, get_chunks
+from kafka_tpu.resilience import faults
+from kafka_tpu.serve import read_response, submit_request
+from kafka_tpu.telemetry.aggregate import parse_prom_text, stitch_traces
+from kafka_tpu.testing.fixtures import make_pivot_mask
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TRACE_FIELDS = ("ph", "ts", "pid", "tid", "name")
+
+#: a date on the default synthetic tile's observation calendar
+#: (base 2017-07-01 + day offsets 1, 3, 5, ... -> Jul 2, Jul 4, ...).
+SERVE_DATE = "2017-07-02T00:00:00"
+
+
+def _env(run_id):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["KAFKA_TPU_RUN_ID"] = run_id
+    env["KAFKA_TPU_LIVE_INTERVAL_S"] = "0.2"
+    env.pop(faults.ENV_VAR, None)
+    return env
+
+
+def _fleet_args(outdir, tel_dir, workers, extra=()):
+    args = [
+        "--operator", "identity", "--outdir", str(outdir),
+        "--ny", "48", "--nx", "48", "--days", "8", "--step", "4",
+        "--obs-every", "2", "--chunk-size", "16",
+        "--retry-delay-s", "0.01", "--queue",
+        "--num-workers", str(workers),
+        "--telemetry-dir", str(tel_dir),
+    ]
+    return args + list(extra)
+
+
+def _serve_cmd(root, tel_dir, extra=()):
+    return [
+        sys.executable, "-m", "kafka_tpu.cli.kafka_serve",
+        "--root", str(root), "--tiles", "1", "--operator", "identity",
+        "--ny", "12", "--nx", "12", "--days", "16", "--step", "4",
+        "--obs-every", "2", "--telemetry-dir", str(tel_dir),
+        *extra,
+    ]
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _assert_wellformed(doc):
+    assert doc["traceEvents"], "stitched trace is empty"
+    for e in doc["traceEvents"]:
+        for field in TRACE_FIELDS:
+            assert field in e, f"{field} missing from {e}"
+
+
+def _span_pids(doc):
+    return {e["pid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+
+
+class TestStitchedTraceGolden:
+    def test_two_workers_plus_daemon_stitch_to_three_tracks(
+            self, tmp_path):
+        """Satellite acceptance: 2 subprocess workers + 1 daemon on CPU
+        -> one merged trace.json with >= 3 distinct process tracks."""
+        run_id = "golden-stitch"
+        env = _env(run_id)
+        tel = tmp_path / "tel"
+
+        # Daemon first, one-shot: the request is pre-dropped into the
+        # inbox, --exit-when-idle serves it and exits 0, dumping its
+        # trace.json fragment under tel/serve.
+        root = tmp_path / "serve"
+        rid = submit_request(str(root), {"tile": "tile0",
+                                         "date": SERVE_DATE})
+        daemon = subprocess.run(
+            _serve_cmd(root, tel / "serve",
+                       extra=["--exit-when-idle",
+                              "--idle-grace-s", "0.5"]),
+            env=env, cwd=REPO_ROOT, capture_output=True, text=True,
+            timeout=600,
+        )
+        assert daemon.returncode == 0, daemon.stderr[-2000:]
+        got = read_response(str(root), rid)
+        assert got and got["status"] == "ok"
+
+        # Then the 2-worker queue fleet over one shared outdir; each
+        # worker dumps its own fragment under tel/fleet/worker_i.
+        fleet = subprocess.run(
+            [sys.executable, "-m", "kafka_tpu.cli.run_synthetic",
+             *_fleet_args(tmp_path / "out", tel / "fleet", workers=2)],
+            env=env, cwd=REPO_ROOT, capture_output=True, text=True,
+            timeout=600,
+        )
+        assert fleet.returncode == 0, fleet.stderr[-2000:]
+        summary = json.loads(fleet.stdout.strip().splitlines()[-1])
+        assert summary["done"] == 9 and summary["failed"] == 0
+
+        doc = stitch_traces(str(tel), run_id=run_id)
+        _assert_wellformed(doc)
+        assert doc["otherData"]["run_ids"] == [run_id]
+        assert len(doc["otherData"]["sources"]) >= 3
+        assert len(_span_pids(doc)) >= 3
+        labels = {
+            e["args"]["name"] for e in doc["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "process_name"
+        }
+        assert any("serve" in lb for lb in labels)
+        assert any("worker_0" in lb for lb in labels)
+        assert any("worker_1" in lb for lb in labels)
+        # The stitched timeline is itself loadable JSON on disk.
+        out = tmp_path / "stitched.json"
+        json.dump(doc, open(out, "w"))
+        assert json.load(open(out))["otherData"]["stitched"] is True
+
+
+class TestFleetChaos:
+    def test_sigkill_worker_flagged_dead_with_correct_sums(
+            self, tmp_path):
+        """ISSUE 10 acceptance: 2 workers + daemon, one worker
+        SIGKILLed mid-chunk -> fleet_status flags the dead host within
+        one heartbeat TTL, counters still sum correctly, the stitched
+        trace is well-formed, and /metrics parses as valid Prometheus
+        exposition."""
+        run_id = "fleet-chaos"
+        env = _env(run_id)
+        tel = tmp_path / "tel"
+        outdir = tmp_path / "out"
+        hostname = socket.gethostname()
+
+        # -- serve daemon with the live HTTP endpoint ----------------
+        port = _free_port()
+        root = tmp_path / "serve"
+        daemon = subprocess.Popen(
+            _serve_cmd(root, tel / "serve",
+                       extra=["--http-port", str(port)]),
+            env=env, cwd=REPO_ROOT,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True,
+        )
+        victim = None
+        try:
+            base = f"http://127.0.0.1:{port}"
+            deadline = time.time() + 180
+            while time.time() < deadline:
+                if daemon.poll() is not None:
+                    pytest.fail(
+                        f"daemon exited rc={daemon.returncode} before "
+                        "serving"
+                    )
+                try:
+                    urllib.request.urlopen(base + "/", timeout=1.0)
+                    break
+                except OSError:
+                    time.sleep(0.1)
+            else:
+                pytest.fail("daemon endpoint never came up")
+
+            rid = submit_request(str(root), {"tile": "tile0",
+                                             "date": SERVE_DATE})
+            deadline = time.time() + 180
+            while time.time() < deadline:
+                got = read_response(str(root), rid)
+                if got is not None:
+                    break
+                time.sleep(0.1)
+            else:
+                pytest.fail("daemon never answered the request")
+            assert got["status"] == "ok"
+
+            # Acceptance: live /metrics parses as valid exposition and
+            # carries the serve counters mid-run.
+            body = urllib.request.urlopen(
+                base + "/metrics", timeout=5.0
+            ).read().decode("utf-8")
+            fams = parse_prom_text(body)
+            admitted = fams["kafka_serve_admitted_total"]["samples"]
+            assert admitted and admitted[0]["value"] >= 1
+            sz = json.loads(urllib.request.urlopen(
+                base + "/statusz", timeout=5.0
+            ).read())
+            assert sz["status"]["sessions"]["tile0"]["serves"] >= 1
+
+            # -- victim worker, SIGKILLed mid-(non-empty)-chunk ------
+            mask = make_pivot_mask(48, 48)
+            slow_leases = {
+                f".chunk_{c.chunk_no:04x}.lease"
+                for c in get_chunks(48, 48, (16, 16))
+                if chunk_mask(mask, c).any()
+            }
+            victim = subprocess.Popen(
+                [sys.executable, "-m", "kafka_tpu.cli.run_synthetic",
+                 *_fleet_args(outdir, tel / "w0", workers=1,
+                              extra=["--lease-ttl-s", "1.0"])],
+                env=env, cwd=REPO_ROOT,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            deadline = time.time() + 180
+            while time.time() < deadline:
+                if victim.poll() is not None:
+                    pytest.fail(
+                        f"victim exited rc={victim.returncode} before "
+                        "it could be killed"
+                    )
+                names = set(
+                    os.listdir(outdir) if os.path.isdir(outdir) else ()
+                )
+                if names & slow_leases:
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail("victim never claimed a non-empty chunk")
+            victim.kill()
+            victim.wait(timeout=30)
+            # The victim heartbeated at least once before dying.
+            victim_key = f"{hostname}:{victim.pid}"
+            victim_snaps = [
+                n for n in os.listdir(tel / "w0")
+                if n == f"live_{hostname}_{victim.pid}.json"
+            ] if os.path.isdir(tel / "w0") else []
+            assert victim_snaps, "victim published no live snapshot"
+
+            # -- survivor finishes the queue -------------------------
+            survivor = subprocess.run(
+                [sys.executable, "-m", "kafka_tpu.cli.run_synthetic",
+                 *_fleet_args(outdir, tel / "w1", workers=1,
+                              extra=["--lease-ttl-s", "1.0"])],
+                env=env, cwd=REPO_ROOT, capture_output=True, text=True,
+                timeout=600,
+            )
+            assert survivor.returncode == 0, survivor.stderr[-2000:]
+            s_summary = json.loads(
+                survivor.stdout.strip().splitlines()[-1]
+            )
+            assert s_summary["failed"] == 0 and \
+                s_summary["pending"] == 0
+            assert s_summary["reclaimed"] >= 1
+
+            # -- drain the daemon cleanly ----------------------------
+            daemon.send_signal(signal.SIGTERM)
+            out, _ = daemon.communicate(timeout=120)
+            assert daemon.returncode == 0
+            d_summary = json.loads(out.strip().splitlines()[-1])
+            assert d_summary["errors"] == 0
+        finally:
+            for proc in (victim, daemon):
+                if proc is not None and proc.poll() is None:
+                    proc.kill()
+
+        # -- the fleet view ------------------------------------------
+        from tools.fleet_status import build_view
+
+        fleet = build_view(str(tel), ttl_s=1.0)
+        workers = {w["key"]: w for w in fleet["workers"]}
+        # Dead host flagged within one heartbeat TTL: the victim's
+        # heartbeat is stale and carries no clean-shutdown marker...
+        assert workers[victim_key]["dead"] is True
+        assert victim_key in fleet["dead_hosts"]
+        # ...while the survivor and the daemon exited cleanly (final
+        # snapshots) and are NOT flagged however long ago they stopped.
+        clean = [w for k, w in workers.items() if k != victim_key]
+        assert clean and all(w["final"] and not w["dead"]
+                             for w in clean)
+        roles = {w["role"] for w in fleet["workers"]}
+        assert {"queue_worker", "serve"} <= roles
+
+        # Counters still sum correctly: the fleet total equals the
+        # per-worker breakdown's sum, and covers at least the
+        # survivor's own completions.
+        done_tag = "kafka_shard_chunks_completed_total"
+        by_worker = fleet["counters_by_worker"][done_tag]
+        assert fleet["counters"][done_tag] == sum(by_worker.values())
+        # The survivor's final snapshot carries its exact completion
+        # count (the victim's last heartbeat may lag its true count —
+        # that is the nature of a SIGKILL).
+        assert s_summary["chunks_run"] in by_worker.values()
+        assert fleet["counters"][done_tag] >= s_summary["chunks_run"]
+        # The queue view (auto-discovered from worker status) agrees:
+        # every chunk reached .done despite the kill.
+        assert fleet["queue"] is not None
+        assert fleet["queue"]["counts"]["done"] == 9
+        assert fleet["queue"]["counts"]["lease_expired"] == 0
+
+        # Trace stitching produces a single well-formed Chrome trace
+        # for the run id (survivor + daemon fragments; the SIGKILLed
+        # victim never got to dump one).
+        doc = stitch_traces(str(tel), run_id=run_id)
+        _assert_wellformed(doc)
+        assert doc["otherData"]["run_ids"] == [run_id]
+        assert len(doc["otherData"]["sources"]) >= 2
+        assert len(_span_pids(doc)) >= 2

@@ -1,0 +1,585 @@
+"""Fleet observability plane (ISSUE 10): the live snapshot publisher,
+the stdlib HTTP endpoint, Prometheus text-exposition conformance
+(parser round-trip), cross-process aggregation with dead-host
+detection, trace stitching, the queue-status liveness join, and the
+fleet-gauge admission signal.  The multi-process acceptance lives in
+tests/test_fleet_chaos.py."""
+
+import json
+import math
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kafka_tpu import telemetry
+from kafka_tpu.telemetry import MetricsRegistry, live, tracing
+from kafka_tpu.telemetry.aggregate import (
+    aggregate_fleet,
+    discover_queue_outdir,
+    load_live_snapshots,
+    parse_prom_text,
+    quantile_from_buckets,
+    stitch_traces,
+    worker_liveness,
+)
+from kafka_tpu.telemetry.httpd import TelemetryHTTPd, maybe_start
+
+
+@pytest.fixture(autouse=True)
+def _clean_publisher():
+    yield
+    live.stop_publisher()
+    live._status.clear()
+
+
+def _get(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.headers.get("Content-Type"), \
+            resp.read().decode("utf-8")
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition conformance: the round-trip pins it.
+# ---------------------------------------------------------------------------
+
+class TestPromExposition:
+    def test_round_trip_counters_and_gauges(self):
+        reg = MetricsRegistry()
+        reg.counter("kafka_test_total", "requests").inc(3, band="b1")
+        reg.counter("kafka_test_total").inc(2, band="b2")
+        reg.gauge("kafka_test_depth", "queue depth").set(2.5)
+        fams = parse_prom_text(reg.prom_text())
+        assert fams["kafka_test_total"]["type"] == "counter"
+        assert fams["kafka_test_total"]["help"] == "requests"
+        by_band = {
+            s["labels"]["band"]: s["value"]
+            for s in fams["kafka_test_total"]["samples"]
+        }
+        assert by_band == {"b1": 3.0, "b2": 2.0}
+        assert fams["kafka_test_depth"]["samples"][0]["value"] == 2.5
+
+    def test_label_escaping_round_trips(self):
+        """Backslash, quote and newline in label values must survive
+        the text format — chunk prefixes and error strings land in
+        labels."""
+        ugly = 'a"b\\c\nd'
+        reg = MetricsRegistry()
+        reg.counter("kafka_test_total").inc(1, err=ugly)
+        fams = parse_prom_text(reg.prom_text())
+        assert fams["kafka_test_total"]["samples"][0]["labels"]["err"] \
+            == ugly
+
+    def test_nonfinite_values_spelled_prometheus_style(self):
+        reg = MetricsRegistry()
+        reg.gauge("kafka_test_inf").set(math.inf)
+        reg.gauge("kafka_test_ninf").set(-math.inf)
+        text = reg.prom_text()
+        assert "kafka_test_inf +Inf" in text
+        assert "kafka_test_ninf -Inf" in text
+        fams = parse_prom_text(text)
+        assert fams["kafka_test_inf"]["samples"][0]["value"] == math.inf
+
+    def test_histogram_buckets_cumulative_with_sum_count(self):
+        """The scraped histogram must satisfy the Prometheus contract:
+        cumulative nondecreasing ``_bucket{le=}`` counts, the ``+Inf``
+        bucket equal to ``_count``, and a ``_sum`` series — otherwise
+        ``histogram_quantile`` over a scrape is garbage."""
+        reg = MetricsRegistry()
+        h = reg.histogram("kafka_test_seconds", "lat",
+                          buckets=(0.1, 0.5, 1.0))
+        for v in (0.05, 0.3, 0.3, 0.7, 5.0):
+            h.observe(v)
+        fams = parse_prom_text(reg.prom_text())
+        assert fams["kafka_test_seconds"]["type"] == "histogram"
+        buckets = {
+            s["labels"]["le"]: s["value"]
+            for s in fams["kafka_test_seconds_bucket"]["samples"]
+        }
+        assert buckets == {"0.1": 1, "0.5": 3, "1": 4, "+Inf": 5}
+        ordered = [buckets["0.1"], buckets["0.5"], buckets["1"],
+                   buckets["+Inf"]]
+        assert ordered == sorted(ordered)  # cumulative, nondecreasing
+        assert fams["kafka_test_seconds_count"]["samples"][0]["value"] \
+            == 5
+        assert fams["kafka_test_seconds_sum"]["samples"][0]["value"] \
+            == pytest.approx(6.35)
+
+    def test_parser_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            parse_prom_text("this is not exposition format\n")
+
+
+# ---------------------------------------------------------------------------
+# Live snapshot publisher.
+# ---------------------------------------------------------------------------
+
+class TestLivePublisher:
+    def test_snapshot_contents_and_final_marker(self, tmp_path):
+        d = str(tmp_path)
+        with telemetry.use(MetricsRegistry(d)) as reg:
+            reg.counter("kafka_test_total").inc(4)
+            reg.gauge("kafka_test_depth").set(7)
+            reg.histogram("kafka_test_seconds",
+                          buckets=(0.1, 1.0)).observe(0.5)
+            reg.gauge("kafka_health_unhealthy").set(0.0)
+            live.update_status(queue_outdir="/q", worker_id="w")
+            # A crash dump on disk must be indexed by the snapshot.
+            open(os.path.join(d, "crash_x_1.json"), "w").write("{}")
+            with tracing.push(run_id="r-pub", chunk_id="00aa"):
+                pub = live.LivePublisher(
+                    d, role="queue_worker", interval_s=30.0
+                ).start()
+                path = pub.publish_now()
+                snap = json.load(open(path))  # pre-stop state
+                pub.stop()
+        assert snap["schema"] == live.SCHEMA_VERSION
+        assert snap["pid"] == os.getpid()
+        assert snap["role"] == "queue_worker"
+        assert snap["run_id"] == "r-pub"
+        assert snap["chunk_id"] == "00aa"
+        assert snap["counters"]["kafka_test_total"] == 4
+        assert snap["gauges"]["kafka_test_depth"] == 7
+        hist = snap["histograms"]["kafka_test_seconds"]
+        assert hist["le"] == [0.1, 1.0] and hist["count"] == 1
+        assert snap["health"]["unhealthy"] is False
+        assert snap["status"]["queue_outdir"] == "/q"
+        assert snap["crash_dumps"] == ["crash_x_1.json"]
+        # stop() republished with the clean-shutdown marker.
+        final = json.load(open(pub.path))
+        assert final["final"] is True
+        assert final["seq"] > snap["seq"]
+        # Atomic writes: no torn tmp litter.
+        assert not [n for n in os.listdir(d) if ".tmp" in n]
+
+    def test_background_thread_republishes(self, tmp_path):
+        with telemetry.use(MetricsRegistry(str(tmp_path))):
+            pub = live.LivePublisher(
+                str(tmp_path), interval_s=0.05
+            ).start()
+            deadline = time.time() + 10
+            seq = 0
+            while time.time() < deadline and seq < 3:
+                try:
+                    seq = json.load(open(pub.path))["seq"]
+                except (OSError, ValueError):
+                    pass
+                time.sleep(0.02)
+            pub.stop()
+        assert seq >= 3
+
+    def test_series_bounded(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(live, "MAX_SERIES", 2)
+        with telemetry.use(MetricsRegistry(str(tmp_path))) as reg:
+            for i in range(5):
+                reg.counter("kafka_test_total").inc(1, k=str(i))
+            snap = live.build_snapshot(reg)
+        assert len(snap["counters"]) == 2
+        assert snap["series_truncated"] == 3
+
+    def test_start_publisher_requires_directory(self):
+        with telemetry.use(MetricsRegistry()):
+            assert live.start_publisher() is None
+
+    def test_flight_recorder_dump_refreshes_snapshot(self, tmp_path):
+        """Satellite: a crash dump must be referenced from the live
+        snapshot immediately — the fleet view points at the forensics
+        file without waiting out the publish interval."""
+        from kafka_tpu.telemetry.flight_recorder import FlightRecorder
+
+        d = str(tmp_path)
+        with telemetry.use(MetricsRegistry(d)):
+            pub = live.start_publisher(directory=d, interval_s=60.0)
+            recorder = FlightRecorder(d)
+            crash = recorder.dump("exception", exc=ValueError("boom"))
+            snap = json.load(open(pub.path))
+            live.stop_publisher()
+        assert os.path.basename(crash) in snap["crash_dumps"]
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint.
+# ---------------------------------------------------------------------------
+
+class TestHTTPd:
+    def test_port_zero_means_disabled(self):
+        assert maybe_start(0) is None
+        assert maybe_start(None) is None
+
+    def test_metrics_endpoint_serves_parseable_exposition(self):
+        with telemetry.use(MetricsRegistry()) as reg:
+            reg.counter("kafka_test_total").inc(2)
+            h = TelemetryHTTPd(port=0).start()
+            try:
+                code, ctype, body = _get(h.url + "/metrics")
+            finally:
+                h.close()
+        assert code == 200
+        assert ctype.startswith("text/plain")
+        fams = parse_prom_text(body)
+        assert fams["kafka_test_total"]["samples"][0]["value"] == 2
+        # The endpoint's own access counter is live too.
+        assert "kafka_httpd_requests_total" in fams
+
+    def test_healthz_reads_registry_verdict(self):
+        with telemetry.use(MetricsRegistry()) as reg:
+            h = TelemetryHTTPd(port=0).start()
+            try:
+                code, _, body = _get(h.url + "/healthz")
+                assert code == 200
+                assert json.loads(body)["verdict"] == "unprobed"
+                reg.gauge("kafka_health_unhealthy").set(0.0)
+                code, _, body = _get(h.url + "/healthz")
+                assert code == 200
+                assert json.loads(body)["verdict"] == "healthy"
+                reg.gauge("kafka_health_unhealthy").set(1.0)
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    _get(h.url + "/healthz")
+                assert exc.value.code == 503
+                assert json.loads(exc.value.read())["verdict"] == \
+                    "unhealthy"
+            finally:
+                h.close()
+
+    def test_statusz_carries_provider_and_crash_index(self, tmp_path):
+        d = str(tmp_path)
+        with telemetry.use(MetricsRegistry(d)) as reg:
+            reg.counter("kafka_solver_nonfinite_total").inc(3)
+            open(os.path.join(d, "crash_y_2.json"), "w").write("{}")
+            h = TelemetryHTTPd(
+                port=0, role="serve",
+                status_provider=lambda: {"queue_depth": 5},
+            ).start()
+            try:
+                with tracing.push(run_id="r-sz"):
+                    code, ctype, body = _get(h.url + "/statusz")
+            finally:
+                h.close()
+        assert code == 200 and ctype == "application/json"
+        sz = json.loads(body)
+        assert sz["pid"] == os.getpid()
+        assert sz["status"]["queue_depth"] == 5
+        assert sz["crash_dumps"] == ["crash_y_2.json"]
+        assert sz["solver_health"]["kafka_solver_nonfinite_total"] == 3
+
+    def test_unknown_path_404s(self):
+        with telemetry.use(MetricsRegistry()):
+            h = TelemetryHTTPd(port=0).start()
+            try:
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    _get(h.url + "/nope")
+                assert exc.value.code == 404
+            finally:
+                h.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet aggregation.
+# ---------------------------------------------------------------------------
+
+def _snap(tmp_path, rel, host, pid, ts, *, final=False, interval=1.0,
+          counters=None, gauges=None, histograms=None, status=None,
+          run_id="r1", role="queue_worker", crash=()):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps({
+        "schema": 1, "ts": ts, "host": host, "pid": pid, "role": role,
+        "seq": 1, "interval_s": interval, "final": final,
+        "run_id": run_id, "chunk_id": None,
+        "health": {"unhealthy": False},
+        "counters": counters or {}, "gauges": gauges or {},
+        "histograms": histograms or {}, "series_truncated": 0,
+        "crash_dumps": list(crash), "status": status or {},
+    }))
+    return str(path)
+
+
+class TestAggregate:
+    def test_counters_sum_gauges_per_host_dead_flagged(self, tmp_path):
+        now = time.time()
+        _snap(tmp_path, "w0/live_hostA_1.json", "hostA", 1, now - 0.2,
+              counters={"kafka_shard_chunks_completed_total": 4},
+              gauges={"kafka_shard_chunks_pending": 2})
+        _snap(tmp_path, "w1/live_hostB_2.json", "hostB", 2, now - 60,
+              counters={"kafka_shard_chunks_completed_total": 5},
+              gauges={"kafka_shard_chunks_pending": 7},
+              crash=["crash_z.json"])
+        _snap(tmp_path, "w2/live_hostC_3.json", "hostC", 3, now - 60,
+              final=True,
+              counters={"kafka_shard_chunks_completed_total": 1})
+        fleet = aggregate_fleet(
+            load_live_snapshots(str(tmp_path)), now=now, ttl_s=5.0
+        )
+        assert fleet["n_workers"] == 3
+        assert fleet["counters"][
+            "kafka_shard_chunks_completed_total"] == 10
+        by = fleet["counters_by_worker"][
+            "kafka_shard_chunks_completed_total"]
+        assert sum(by.values()) == 10 and len(by) == 3
+        assert fleet["gauges"]["kafka_shard_chunks_pending"] == {
+            "hostA:1": 2, "hostB:2": 7,
+        }
+        # Stale heartbeat without a final marker = dead; a clean exit
+        # (final) is never dead however old.
+        assert fleet["dead_hosts"] == ["hostB:2"]
+        assert fleet["crash_dumps"] == [
+            {"worker": "hostB:2", "file": "crash_z.json"}
+        ]
+        assert fleet["run_ids"] == ["r1"]
+
+    def test_default_ttl_is_three_intervals(self, tmp_path):
+        now = time.time()
+        _snap(tmp_path, "live_h_9.json", "h", 9, now - 2.0,
+              interval=1.0)
+        fleet = aggregate_fleet(load_live_snapshots(str(tmp_path)),
+                                now=now)
+        assert fleet["dead_hosts"] == []  # 2s < 3x1s
+        fleet = aggregate_fleet(load_live_snapshots(str(tmp_path)),
+                                now=now + 2.0)
+        assert fleet["dead_hosts"] == ["h:9"]
+
+    def test_newest_snapshot_wins_per_worker(self, tmp_path):
+        now = time.time()
+        _snap(tmp_path, "a/live_h_1.json", "h", 1, now - 50,
+              counters={"kafka_test_total": 1})
+        _snap(tmp_path, "b/live_h_1.json", "h", 1, now - 1,
+              counters={"kafka_test_total": 6})
+        fleet = aggregate_fleet(load_live_snapshots(str(tmp_path)),
+                                now=now, ttl_s=5.0)
+        assert fleet["n_workers"] == 1
+        assert fleet["counters"]["kafka_test_total"] == 6
+        assert fleet["dead_hosts"] == []
+
+    def test_histograms_merge_into_fleet_quantiles(self, tmp_path):
+        now = time.time()
+        le = [1.0, 2.0, 4.0]
+        _snap(tmp_path, "w0/live_h_1.json", "h", 1, now,
+              histograms={"kafka_serve_latency_seconds": {
+                  "le": le, "buckets": [10, 10, 10], "sum": 5.0,
+                  "count": 10}})
+        _snap(tmp_path, "w1/live_h_2.json", "h", 2, now,
+              histograms={"kafka_serve_latency_seconds": {
+                  "le": le, "buckets": [0, 10, 10], "sum": 15.0,
+                  "count": 10}})
+        fleet = aggregate_fleet(load_live_snapshots(str(tmp_path)),
+                                now=now, ttl_s=5.0)
+        h = fleet["histograms"]["kafka_serve_latency_seconds"]
+        assert h["count"] == 20 and h["sum"] == 20.0
+        # Merged cumulative buckets: [10, 20, 20] — the median falls
+        # exactly at the first bucket's boundary.
+        assert h["p50"] == pytest.approx(1.0)
+        assert h["p99"] == pytest.approx(1.98)
+
+    def test_quantile_interpolation(self):
+        assert quantile_from_buckets([1.0, 2.0], [5, 10], 10, 0.5) \
+            == pytest.approx(1.0)
+        assert quantile_from_buckets([1.0, 2.0], [0, 10], 10, 0.5) \
+            == pytest.approx(1.5)
+        # Beyond the last finite bucket: clamp to its bound.
+        assert quantile_from_buckets([1.0, 2.0], [0, 0], 10, 0.5) == 2.0
+        assert quantile_from_buckets([], [], 0, 0.5) is None
+
+    def test_queue_outdir_discovery_and_liveness(self, tmp_path):
+        now = time.time()
+        _snap(tmp_path, "live_h_1.json", "h", 1, now - 0.1,
+              status={"queue_outdir": "/data/q", "worker_id": "h:1"})
+        snaps = load_live_snapshots(str(tmp_path))
+        assert discover_queue_outdir(snaps) == "/data/q"
+        lv = worker_liveness(snaps, now=now, ttl_s=5.0)
+        assert lv["h:1"]["dead"] is False
+        assert lv["h:1"]["age_s"] == pytest.approx(0.1, abs=0.05)
+
+
+# ---------------------------------------------------------------------------
+# Trace stitching (unit; the multi-process golden test lives in
+# test_fleet_chaos.py).
+# ---------------------------------------------------------------------------
+
+class TestStitchTraces:
+    def _fragment(self, tmp_path, rel, run_id, epoch, span="work"):
+        from kafka_tpu.telemetry.tracing import TraceBuffer
+
+        buf = TraceBuffer()
+        buf.epoch = epoch
+        t0 = time.perf_counter()
+        with tracing.push(run_id=run_id):
+            buf.add_span(span, t0, t0 + 0.01)
+        d = tmp_path / rel
+        d.mkdir(parents=True, exist_ok=True)
+        buf.export(str(d / "trace.json"))
+
+    def test_stitch_remaps_pids_and_aligns_epochs(self, tmp_path):
+        self._fragment(tmp_path, "worker_0", "r-st", 100.0, span="w0")
+        self._fragment(tmp_path, "worker_1", "r-st", 103.0, span="w1")
+        self._fragment(tmp_path, "other", "r-unrelated", 101.0,
+                       span="noise")
+        doc = stitch_traces(str(tmp_path), run_id="r-st")
+        assert doc["otherData"]["run_ids"] == ["r-st"]
+        assert len(doc["otherData"]["sources"]) == 2
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        names = {e["name"] for e in spans}
+        assert names == {"w0", "w1"}
+        pids = {e["pid"] for e in spans}
+        assert len(pids) == 2
+        # Epoch alignment: worker_1's fragment started 3s later, so its
+        # span timestamps sit ~3e6 us after worker_0's.
+        ts = {e["name"]: e["ts"] for e in spans}
+        assert ts["w1"] - ts["w0"] == pytest.approx(3e6, rel=0.1)
+        # Every source gets a named process track.
+        proc_names = {
+            e["args"]["name"] for e in doc["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "process_name"
+        }
+        assert proc_names == {"kafka_tpu worker_0", "kafka_tpu worker_1"}
+
+    def test_no_filter_merges_everything(self, tmp_path):
+        self._fragment(tmp_path, "a", "r1", 100.0)
+        self._fragment(tmp_path, "b", "r2", 100.0)
+        doc = stitch_traces(str(tmp_path))
+        assert sorted(doc["otherData"]["run_ids"]) == ["r1", "r2"]
+        assert len(doc["otherData"]["sources"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# Operator CLIs: fleet_status and the queue_status liveness join.
+# ---------------------------------------------------------------------------
+
+class TestFleetStatusCLI:
+    def test_json_view_and_render(self, tmp_path, capsys):
+        from tools.fleet_status import main
+
+        now = time.time()
+        _snap(tmp_path, "w0/live_hostA_1.json", "hostA", 1, now - 0.1,
+              counters={"kafka_shard_chunks_completed_total": 3})
+        _snap(tmp_path, "w1/live_hostB_2.json", "hostB", 2, now - 500)
+        assert main([str(tmp_path), "--json", "--ttl-s", "5"]) == 0
+        fleet = json.loads(capsys.readouterr().out)
+        assert fleet["dead_hosts"] == ["hostB:2"]
+        assert fleet["counters"][
+            "kafka_shard_chunks_completed_total"] == 3
+        assert main([str(tmp_path), "--ttl-s", "5"]) == 0
+        out = capsys.readouterr().out
+        assert "DEAD" in out and "hostB:2" in out
+
+    def test_missing_root_is_usage_error(self, tmp_path, capsys):
+        from tools.fleet_status import main
+
+        assert main([str(tmp_path / "nope")]) == 2
+
+    def test_stitch_trace_flag_writes_merged_trace(self, tmp_path,
+                                                   capsys):
+        from tools.fleet_status import main
+
+        TestStitchTraces()._fragment(tmp_path, "w0", "rf", 100.0)
+        out = tmp_path / "merged.json"
+        assert main([str(tmp_path), "--json",
+                     "--stitch-trace", str(out), "--run-id", "rf"]) == 0
+        doc = json.load(open(out))
+        assert doc["otherData"]["run_ids"] == ["rf"]
+        assert any(e["ph"] == "X" for e in doc["traceEvents"])
+
+
+class TestQueueStatusLiveness:
+    def test_liveness_joined_to_lease_ownership(self, tmp_path, capsys):
+        from tools.queue_status import main
+        from kafka_tpu.shard.queue import _try_claim, write_manifest
+        from kafka_tpu.io.tiling import get_chunks
+
+        outdir = tmp_path / "q"
+        outdir.mkdir()
+        chunks = list(get_chunks(64, 32, (32, 32)))
+        write_manifest(str(outdir), chunks)
+        _try_claim(str(outdir), "0001", "hostA:1", lease_ttl_s=60.0)
+        tel = tmp_path / "tel"
+        now = time.time()
+        _snap(tel, "w/live_hostA_1.json", "hostA", 1, now - 90)
+        rc = main([str(outdir), "--json",
+                   "--telemetry-dir", str(tel), "--ttl-s", "5"])
+        assert rc == 0
+        status = json.loads(capsys.readouterr().out)
+        assert status["liveness"]["hostA:1"]["dead"] is True
+        rc = main([str(outdir), "--telemetry-dir", str(tel),
+                   "--ttl-s", "5"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "DEAD" in out and "hostA:1" in out
+
+
+# ---------------------------------------------------------------------------
+# Serve-side fleet awareness: admission sheds on the fleet gauge.
+# ---------------------------------------------------------------------------
+
+class TestFleetAdmission:
+    def test_sheds_when_fleet_degraded(self):
+        from kafka_tpu.serve.admission import (
+            AdmissionController, AdmissionPolicy,
+        )
+
+        with telemetry.use(MetricsRegistry()) as reg:
+            ctl = AdmissionController(
+                AdmissionPolicy(max_dead_hosts=0)
+            )
+            assert ctl.decide(queue_depth=0) is None  # gauge unset
+            reg.gauge("kafka_fleet_dead_hosts").set(1)
+            assert ctl.decide(queue_depth=0) == "fleet_degraded"
+            reg.gauge("kafka_fleet_dead_hosts").set(0)
+            assert ctl.decide(queue_depth=0) is None
+            # Default policy ignores the gauge entirely.
+            reg.gauge("kafka_fleet_dead_hosts").set(9)
+            assert AdmissionController().decide(queue_depth=0) is None
+
+    def test_daemon_refreshes_gauge_from_snapshots(self, tmp_path):
+        from kafka_tpu.serve.daemon import ServeDaemon
+
+        now = time.time()
+        _snap(tmp_path / "fleet", "w/live_deadhost_7.json",
+              "deadhost", 7, now - 900)
+        with telemetry.use(MetricsRegistry()) as reg:
+            daemon = ServeDaemon.__new__(ServeDaemon)
+            daemon.fleet_dir = str(tmp_path / "fleet")
+            daemon.fleet_refresh_s = 0.0
+            daemon.fleet_ttl_s = 5.0
+            daemon._fleet_next = 0.0
+            daemon._refresh_fleet_gauge()
+            assert reg.value("kafka_fleet_dead_hosts") == 1
+            assert any(e["event"] == "fleet_dead_hosts_changed"
+                       for e in reg.events)
+
+
+# ---------------------------------------------------------------------------
+# bench_compare: live_telemetry diffed informationally.
+# ---------------------------------------------------------------------------
+
+class TestBenchCompareLiveTelemetry:
+    ART = {
+        "device_xla_ms": 6.4, "unhealthy": False,
+        "live_telemetry": {
+            "samples": 3,
+            "series": {"kafka_serve_queue_depth": [0, 4, 0]},
+        },
+    }
+
+    def test_informational_lines_never_gate(self, tmp_path, capsys):
+        from tools.bench_compare import live_telemetry_deltas, main
+
+        new = json.loads(json.dumps(self.ART))
+        new["live_telemetry"]["series"][
+            "kafka_serve_queue_depth"] = [0, 9, 1]
+        lines = live_telemetry_deltas(self.ART, new)
+        assert any("queue_depth" in line and "peak 4 -> 9" in line
+                   for line in lines)
+        old_p = tmp_path / "old.json"
+        new_p = tmp_path / "new.json"
+        old_p.write_text(json.dumps(self.ART))
+        new_p.write_text(json.dumps(new))
+        assert main([str(old_p), str(new_p)]) == 0
+        out = capsys.readouterr().out
+        assert "live telemetry deltas" in out
+
+    def test_identical_series_stay_silent(self):
+        from tools.bench_compare import live_telemetry_deltas
+
+        assert live_telemetry_deltas(self.ART, self.ART) == []
+        assert live_telemetry_deltas({}, {}) == []

@@ -717,6 +717,12 @@ class TestLoadgen:
         assert rows["serve_p99_ms"] >= rows["serve_p50_ms"]
         assert rows["serve_cold_ms"] > 0
         assert rows["serve_rejected_total"] == 0
+        # ISSUE 10 satellite: the bench scrapes its own ephemeral
+        # /metrics endpoint mid-run and embeds the serve series.
+        lt = rows["live_telemetry"]
+        assert lt["samples"] >= 1 and lt["scrape_errors"] == 0
+        assert lt["scrape_url"].endswith("/metrics")
+        assert any(k.startswith("kafka_serve_") for k in lt["series"])
 
     def test_rejections_counted_not_waited(self, tmp_path):
         from tools.loadgen import _Target, run_load

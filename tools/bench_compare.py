@@ -138,6 +138,40 @@ def solver_health_deltas(old: dict, new: dict
     return warnings, lines
 
 
+def live_telemetry_deltas(old: dict, new: dict) -> List[str]:
+    """Informational diff of the embedded ``live_telemetry`` mid-run
+    scrape series (tools/loadgen): per shared series, the peak and the
+    final sample side by side.  Never gated — the series show HOW a
+    latency shift happened (queue build-up vs admission shedding), they
+    are not themselves a timing."""
+    s_old = (old.get("live_telemetry") or {}).get("series") or {}
+    s_new = (new.get("live_telemetry") or {}).get("series") or {}
+    lines: List[str] = []
+    for key in sorted(set(s_old) & set(s_new)):
+        a, b = s_old[key], s_new[key]
+        if not a or not b:
+            continue
+        try:
+            peak_a, peak_b = max(a), max(b)
+            last_a, last_b = a[-1], b[-1]
+        except TypeError:
+            continue
+        if (peak_a, last_a) == (peak_b, last_b):
+            continue
+        lines.append(
+            f"  {key}: peak {peak_a:g} -> {peak_b:g}, "
+            f"final {last_a:g} -> {last_b:g}"
+        )
+    only = [
+        f"  ({side} artifact carries no live_telemetry scrape)"
+        for side, art in (("old", old), ("new", new))
+        if not (art.get("live_telemetry") or {}).get("series")
+    ]
+    if only and (s_old or s_new):
+        lines.extend(only)
+    return lines
+
+
 def telemetry_deltas(old: dict, new: dict, top: int = 8) -> List[str]:
     """Largest relative changes between the embedded registry snapshots
     (context for a timing shift; never gated on)."""
@@ -188,6 +222,11 @@ def main(argv=None) -> int:
     if deltas:
         print("telemetry deltas (context, not gated):")
         for line in deltas:
+            print(line)
+    live_lines = live_telemetry_deltas(old, new)
+    if live_lines:
+        print("live telemetry deltas (mid-run scrape, not gated):")
+        for line in live_lines:
             print(line)
     health_warnings, health_lines = solver_health_deltas(old, new)
     if health_lines:

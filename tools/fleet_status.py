@@ -1,0 +1,165 @@
+"""fleet_status — one live view over a multi-process kafka_tpu fleet.
+
+Merges every ``live_<host>_<pid>.json`` heartbeat snapshot under a
+telemetry root (``kafka_tpu.telemetry.live``) into the fleet view
+(``kafka_tpu.telemetry.aggregate``): per-worker liveness (heartbeat
+age; a stale heartbeat without a clean-shutdown marker flags the host
+DEAD), counters summed across processes, gauges per host, serve/phase
+latency histograms merged into fleet p50/p99, crash-dump pointers, and
+— when the workers ran the PR 7 lease queue — the queue's chunk counts
+(auto-discovered from worker status, or ``--queue-dir``).
+
+``--stitch-trace OUT.json`` additionally merges the per-process
+``trace.json`` fragments under the root into ONE Chrome trace (each
+process its own named pid track, timestamps aligned on the shared
+wall-clock epoch) — open it at https://ui.perfetto.dev.
+
+Usage:
+    python -m tools.fleet_status /path/to/telemetry [--json]
+        [--ttl-s 6] [--queue-dir DIR] [--stitch-trace OUT] [--run-id ID]
+
+Exit codes: 0 (view rendered, dead hosts included — liveness is a
+report, not an error), 2 usage/missing root.  Strictly read-only apart
+from the optional stitched-trace output file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def render(fleet: dict) -> str:
+    """Human-readable one-screen summary of an ``aggregate_fleet``
+    view (+ the optional ``queue`` section)."""
+    lines = [
+        f"fleet: {fleet['n_workers']} worker(s), "
+        f"run_ids={','.join(fleet['run_ids']) or '-'}",
+    ]
+    for w in fleet["workers"]:
+        state = "DEAD" if w["dead"] else \
+            ("exited" if w["final"] else "live")
+        extra = ""
+        if w["crash_dumps"]:
+            extra = f"  crash={w['crash_dumps'][-1]}"
+        lines.append(
+            f"  {w['key']} [{w['role']}] {state}  "
+            f"heartbeat {w['age_s']:.1f}s ago{extra}"
+        )
+    if fleet["dead_hosts"]:
+        lines.append(f"dead hosts: {', '.join(fleet['dead_hosts'])}")
+    queue = fleet.get("queue")
+    if queue:
+        c = queue["counts"]
+        lines.append(
+            f"queue: {queue['outdir']}  done={c['done']} "
+            f"failed={c['failed']} leased={c['leased']} "
+            f"expired={c['lease_expired']} pending={c['pending']}"
+        )
+    interesting = [
+        (k, v) for k, v in sorted(fleet["counters"].items())
+        if not k.startswith("kafka_live_")
+    ]
+    if interesting:
+        lines.append("counters (fleet totals):")
+        for k, v in interesting[:24]:
+            lines.append(f"  {k} {v:g}")
+        if len(interesting) > 24:
+            lines.append(f"  ... {len(interesting) - 24} more "
+                         "(use --json)")
+    hists = {
+        k: h for k, h in sorted(fleet["histograms"].items())
+        if h["count"]
+    }
+    if hists:
+        lines.append("histograms (fleet-merged):")
+        for k, h in hists.items():
+            p50 = "-" if h["p50"] is None else f"{h['p50']:.4g}"
+            p99 = "-" if h["p99"] is None else f"{h['p99']:.4g}"
+            lines.append(
+                f"  {k}  n={h['count']} p50={p50} p99={p99}"
+            )
+    if fleet["crash_dumps"]:
+        lines.append("crash dumps:")
+        for c in fleet["crash_dumps"]:
+            lines.append(f"  {c['worker']}: {c['file']}")
+    return "\n".join(lines)
+
+
+def build_view(root: str, ttl_s=None, queue_dir=None) -> dict:
+    """The fleet view dict (the ``--json`` payload), importable for
+    tests and other tools."""
+    from kafka_tpu.telemetry.aggregate import (
+        aggregate_fleet, discover_queue_outdir, load_live_snapshots,
+        worker_liveness,
+    )
+
+    snaps = load_live_snapshots(root)
+    fleet = aggregate_fleet(snaps, ttl_s=ttl_s)
+    fleet["telemetry_root"] = os.path.abspath(root)
+    queue_dir = queue_dir or discover_queue_outdir(snaps)
+    fleet["queue"] = None
+    if queue_dir and os.path.isdir(queue_dir):
+        from kafka_tpu.shard.queue import queue_status
+
+        status = queue_status(queue_dir)
+        liveness = worker_liveness(snaps, ttl_s=ttl_s)
+        for owner, w in status["workers"].items():
+            w["liveness"] = liveness.get(owner)
+        fleet["queue"] = status
+    return fleet
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("root", help="telemetry root holding live_*.json "
+                                 "snapshots (searched recursively)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable dump instead of the summary")
+    ap.add_argument("--ttl-s", type=float, default=None,
+                    help="heartbeat staleness beyond which a non-final "
+                         "snapshot flags its host dead (default: 3x "
+                         "each snapshot's own publish interval)")
+    ap.add_argument("--queue-dir", default=None,
+                    help="lease-queue outdir to fold in (default: "
+                         "auto-discovered from worker snapshots)")
+    ap.add_argument("--stitch-trace", default=None, metavar="OUT",
+                    help="also merge per-process trace.json fragments "
+                         "under the root into OUT (one Chrome trace)")
+    ap.add_argument("--run-id", default=None,
+                    help="only stitch trace fragments carrying this "
+                         "run id")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.root):
+        print(f"fleet_status: no such directory: {args.root}",
+              file=sys.stderr)
+        return 2
+    fleet = build_view(args.root, ttl_s=args.ttl_s,
+                       queue_dir=args.queue_dir)
+    if args.stitch_trace:
+        from kafka_tpu.telemetry.aggregate import stitch_traces
+
+        doc = stitch_traces(args.root, run_id=args.run_id)
+        with open(args.stitch_trace, "w") as f:
+            json.dump(doc, f)
+        fleet["stitched_trace"] = {
+            "path": os.path.abspath(args.stitch_trace),
+            "sources": doc["otherData"]["sources"],
+            "events": len(doc["traceEvents"]),
+        }
+    if args.json:
+        print(json.dumps(fleet, indent=2, sort_keys=True))
+    else:
+        print(render(fleet))
+        if fleet.get("stitched_trace"):
+            st = fleet["stitched_trace"]
+            print(f"stitched trace: {st['path']} "
+                  f"({len(st['sources'])} process track(s), "
+                  f"{st['events']} events)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -223,7 +223,9 @@ def _apply_baseline(findings: List[Finding], entries: List[dict],
 def make_rules(rule_names: Optional[Sequence[str]] = None) -> List[Rule]:
     # Import for the registration side effect; late so core stays
     # importable on its own (the shim path).
-    from . import rules_jax, rules_runtime, rules_telemetry  # noqa: F401
+    from . import (  # noqa: F401
+        rules_jax, rules_publisher, rules_runtime, rules_telemetry,
+    )
 
     names = sorted(REGISTRY) if rule_names is None else list(rule_names)
     unknown = [n for n in names if n not in REGISTRY]

@@ -37,6 +37,93 @@ from typing import List, Optional
 import numpy as np
 
 
+class _MetricsScraper:
+    """Background ``/metrics`` sampler: scrapes a live telemetry
+    endpoint (``kafka_tpu.telemetry.httpd``) every ``interval_s`` while
+    the load runs and keeps the ``kafka_serve_*`` series as a time
+    series — the BENCH JSON's ``live_telemetry`` block, so an artifact
+    shows HOW the queue depth and admission counters moved under load,
+    not just the final totals."""
+
+    PREFIX = "kafka_serve_"
+
+    def __init__(self, url: str, interval_s: float = 0.25,
+                 max_samples: int = 240):
+        self.url = url.rstrip("/") + "/metrics"
+        self.interval_s = interval_s
+        self.max_samples = max_samples
+        self.samples: List[dict] = []
+        self.errors = 0
+        self._stop = threading.Event()
+        # Client-side thread by design, like the loadgen workers: it
+        # models an external Prometheus scraper, not daemon internals.
+        # kafkalint: disable=untracked-thread — external-scraper model;
+        # must not join the daemon's trace timeline.
+        self._thread = threading.Thread(
+            target=self._run, name="loadgen-scraper", daemon=True,
+        )
+
+    def scrape_once(self) -> Optional[dict]:
+        import urllib.request
+
+        from kafka_tpu.telemetry.aggregate import parse_prom_text
+
+        try:
+            with urllib.request.urlopen(self.url, timeout=2.0) as resp:
+                families = parse_prom_text(
+                    resp.read().decode("utf-8")
+                )
+        except (OSError, ValueError):
+            self.errors += 1
+            return None
+        sample = {"t": round(time.time(), 3)}
+        for name, fam in families.items():
+            if not name.startswith(self.PREFIX):
+                continue
+            for s in fam["samples"]:
+                labels = s["labels"]
+                tag = name
+                if labels:
+                    tag += "{" + ",".join(
+                        f"{k}={v}" for k, v in sorted(labels.items())
+                    ) + "}"
+                sample[tag] = s["value"]
+        return sample
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            sample = self.scrape_once()
+            if sample is not None and len(self.samples) < \
+                    self.max_samples:
+                self.samples.append(sample)
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "_MetricsScraper":
+        self._thread.start()
+        return self
+
+    def stop(self) -> dict:
+        """Stop sampling (one final scrape included) and return the
+        ``live_telemetry`` block."""
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        final = self.scrape_once()
+        if final is not None and len(self.samples) < self.max_samples:
+            self.samples.append(final)
+        series: dict = {}
+        for sample in self.samples:
+            for key, v in sample.items():
+                if key == "t":
+                    continue
+                series.setdefault(key, []).append(v)
+        return {
+            "scrape_url": self.url,
+            "samples": len(self.samples),
+            "scrape_errors": self.errors,
+            "series": series,
+        }
+
+
 def _percentiles(latencies_ms: List[float]) -> tuple:
     if not latencies_ms:
         return None, None
@@ -207,6 +294,13 @@ def bench_serve(
         sessions, tmpdir,
         policy=AdmissionPolicy(max_queue_depth=max(64, requests + 1)),
     ).start()
+    # Live observability ride-along: an ephemeral /metrics endpoint over
+    # the in-process registry, scraped MID-RUN so the artifact carries a
+    # live_telemetry time series next to the latency rows.
+    from kafka_tpu.telemetry.httpd import TelemetryHTTPd
+
+    httpd = TelemetryHTTPd(port=0, role="serve").start()
+    scraper = None
     try:
         target = _Target(service=service)
         cold_ms = None
@@ -224,11 +318,17 @@ def bench_serve(
         plan = synthetic_request_plan(
             dates[-4:], sorted(sessions), requests
         )
+        scraper = _MetricsScraper(httpd.url).start()
         rows = run_load(target, plan, concurrency=concurrency,
                         timeout_s=600.0)
         rows["serve_cold_ms"] = cold_ms
+        rows["live_telemetry"] = scraper.stop()
+        scraper = None
         return rows
     finally:
+        if scraper is not None:
+            scraper.stop()
+        httpd.close()
         service.close()
 
 
@@ -248,6 +348,12 @@ def main(argv=None) -> int:
                          "mode; default: the synthetic default ladder)")
     ap.add_argument("--deadline-s", type=float, default=None)
     ap.add_argument("--timeout-s", type=float, default=300.0)
+    ap.add_argument("--scrape-url", default=None,
+                    help="a running daemon's live endpoint (e.g. "
+                         "http://127.0.0.1:8080 from kafka-serve "
+                         "--http-port); /metrics is scraped mid-run and "
+                         "embedded as the live_telemetry series "
+                         "(--root mode)")
     args = ap.parse_args(argv)
 
     if args.root:
@@ -267,10 +373,14 @@ def main(argv=None) -> int:
         if args.deadline_s:
             for p in plan:
                 p["deadline_s"] = args.deadline_s
+        scraper = _MetricsScraper(args.scrape_url).start() \
+            if args.scrape_url else None
         rows = run_load(
             _Target(root=args.root), plan,
             concurrency=args.concurrency, timeout_s=args.timeout_s,
         )
+        if scraper is not None:
+            rows["live_telemetry"] = scraper.stop()
     else:
         import tempfile
         import shutil

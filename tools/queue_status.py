@@ -8,6 +8,13 @@ a marker, so it is safe to run against a live fleet.
 
 Usage:
     python -m tools.queue_status /path/to/outdir [--json]
+        [--telemetry-dir DIR]
+
+``--telemetry-dir`` joins the fleet plane's live heartbeat snapshots
+(``kafka_tpu.telemetry.live``) against lease ownership: each worker
+line gains its heartbeat age and a DEAD flag when the heartbeat went
+stale without a clean shutdown — "who holds this lease" and "is that
+worker still breathing" in one view.
 
 Exit codes: 0 (state rendered, whatever it is), 2 usage/missing outdir.
 PENDING counts need the ``.queue_manifest.json`` a queue worker writes
@@ -23,9 +30,21 @@ import os
 import sys
 
 
+def _liveness_text(info) -> str:
+    if info is None:
+        return "  (no live snapshot)"
+    if info["dead"]:
+        return f"  DEAD (heartbeat {info['age_s']:.1f}s stale)"
+    if info["final"]:
+        return f"  exited cleanly {info['age_s']:.1f}s ago"
+    return f"  heartbeat {info['age_s']:.1f}s ago"
+
+
 def render(status: dict) -> str:
-    """Human-readable one-screen summary of a ``queue_status()`` dict."""
+    """Human-readable one-screen summary of a ``queue_status()`` dict
+    (plus the optional ``liveness`` join)."""
     c = status["counts"]
+    liveness = status.get("liveness")
     lines = [
         f"queue: {status['outdir']}",
         f"chunks: {status['n_chunks']}"
@@ -46,7 +65,10 @@ def render(status: dict) -> str:
                 parts.append(f"live={','.join(w['live'])}")
             if w["expired"]:
                 parts.append(f"EXPIRED={','.join(w['expired'])}")
-            lines.append(f"  {owner}: {' '.join(parts)}")
+            alive = ""
+            if liveness is not None:
+                alive = _liveness_text(liveness.get(owner))
+            lines.append(f"  {owner}: {' '.join(parts)}{alive}")
     interesting = {
         p: e for p, e in status["chunks"].items()
         if e["state"] not in ("done",)
@@ -70,6 +92,13 @@ def main(argv=None) -> int:
     ap.add_argument("outdir", help="queue output directory to inspect")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable dump instead of the summary")
+    ap.add_argument("--telemetry-dir", default=None,
+                    help="telemetry root holding live_*.json heartbeat "
+                         "snapshots; joins worker liveness (heartbeat "
+                         "age, dead flag) against lease ownership")
+    ap.add_argument("--ttl-s", type=float, default=None,
+                    help="heartbeat staleness that flags a worker dead "
+                         "(default: 3x each snapshot's own interval)")
     args = ap.parse_args(argv)
     if not os.path.isdir(args.outdir):
         print(f"queue_status: no such directory: {args.outdir}",
@@ -78,6 +107,14 @@ def main(argv=None) -> int:
     from kafka_tpu.shard.queue import queue_status
 
     status = queue_status(args.outdir)
+    if args.telemetry_dir:
+        from kafka_tpu.telemetry.aggregate import (
+            load_live_snapshots, worker_liveness,
+        )
+
+        status["liveness"] = worker_liveness(
+            load_live_snapshots(args.telemetry_dir), ttl_s=args.ttl_s,
+        )
     if args.json:
         print(json.dumps(status, indent=2, sort_keys=True))
     else:
