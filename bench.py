@@ -447,6 +447,40 @@ def assemble_result(
         # quarantining pixels must not read as a clean win.  Always
         # present (zeros on a healthy run).
         "solver_health": solver_health_snapshot(reg),
+        # Compact ASSIMILATION-quality snapshot (BASELINE.md
+        # "Assimilation quality"): filter-consistency verdict counts and
+        # drift-sentinel state from the run's quality ledger, so a
+        # benchmark whose filter went statistically inconsistent cannot
+        # archive as a clean number — tools/bench_compare.py warns
+        # LOUDLY when a previously-CONSISTENT benchmark flips verdict.
+        "quality": quality_snapshot(reg),
+    }
+
+
+def quality_snapshot(registry=None) -> dict:
+    """The run's assimilation-quality state as a compact dict: window
+    counts per consistency verdict (``kafka_quality_windows_total``),
+    drift-sentinel totals, and the run's overall (worst) verdict — None
+    when the run recorded no quality windows."""
+    from kafka_tpu.telemetry import quality as _quality
+
+    reg = registry if registry is not None else get_registry()
+    windows = {}
+    for v in _quality.VERDICTS:
+        n = reg.value("kafka_quality_windows_total", verdict=v)
+        windows[v] = 0 if n is None else int(n)
+    events_total = 0.0
+    for key, val in reg.flat().items():
+        if key.startswith("kafka_quality_drift_events_total"):
+            events_total += float(val)
+    return {
+        "verdict": _quality.worst_verdict(
+            v for v, n in windows.items() if n
+        ),
+        "windows": windows,
+        "drift_events": int(events_total),
+        "drift_active": int(reg.value("kafka_quality_drift_active")
+                            or 0),
     }
 
 

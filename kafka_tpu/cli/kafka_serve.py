@@ -107,6 +107,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="shed requests (reason fleet_degraded) while "
                          "the fleet view counts more dead hosts than "
                          "this (needs --fleet-dir)")
+    ap.add_argument("--shed-quality-drift", action="store_true",
+                    help="shed requests (reason quality_degraded) "
+                         "while any quality drift sentinel is alarming "
+                         "(kafka_quality_drift_active > 0); default "
+                         "serves degraded answers labelled via the "
+                         "response's quality field")
     add_telemetry_arg(ap)
     ap.add_argument("--verbose", action="store_true")
     return ap
@@ -164,6 +170,7 @@ def main(argv=None):
         ),
         shed_when_unhealthy=not args.no_shed_unhealthy,
         max_dead_hosts=args.max_dead_hosts,
+        shed_on_quality_drift=args.shed_quality_drift,
     )
     service = AssimilationService(
         sessions, args.root, policy=policy,

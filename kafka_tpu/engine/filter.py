@@ -43,6 +43,7 @@ from ..resilience import (
 from ..telemetry import (
     fetch_scalars,
     get_registry,
+    quality,
     record_memory_watermark,
     span,
     tracing,
@@ -153,6 +154,11 @@ class KalmanFilter:
         # the unfused window path reads the degradation from here
         # (prefetcher dates pop exactly once).
         self._degraded_pending: set = set()
+        # Fetch-order date counter: the ``obs.bias`` chaos site
+        # addresses observation dates by this 1-based number
+        # (telemetry.quality.observation_bias; degraded fetches count
+        # too, so the numbering is deterministic either way).
+        self._obs_date_no = 0
         # The reference's LEGACY band-sequential path
         # (``linear_kf.py:325-425``): each band assimilates alone, its
         # posterior becoming the next band's prior, with its own
@@ -301,9 +307,15 @@ class KalmanFilter:
         if date in self._degraded_pending:
             self._degraded_pending.discard(date)
             return None
+        # One number per date, in fetch order (pending replays above
+        # were numbered when first fetched) — the obs.bias address.
+        self._obs_date_no += 1
+        date_no = self._obs_date_no
         if self._prefetcher is not None:
             try:
-                return self._prefetcher.get(date)
+                return self._apply_obs_bias(
+                    self._prefetcher.get(date), date_no
+                )
             except DegradedDateError as exc:
                 self._note_degraded(date, exc.cause)
                 return None
@@ -319,7 +331,23 @@ class KalmanFilter:
                 raise
             self._note_degraded(date, exc)
             return None
-        return self._shard_obs(obs)
+        return self._apply_obs_bias(self._shard_obs(obs), date_no)
+
+    def _apply_obs_bias(self, obs: DateObservation,
+                        date_no: int) -> DateObservation:
+        """The ``obs.bias`` chaos site: when an armed fault spec matches
+        this fetch-order date number, add the scripted bias to the
+        date's VALID observations (masked entries stay untouched).  The
+        bias rides the traced ``y`` data, so the compiled program is
+        identical armed or not; disarmed, nothing is touched at all."""
+        bias = quality.observation_bias(date_no)
+        if bias is None:
+            return obs
+        bands = obs.bands
+        y = bands.y + jnp.float32(bias) * bands.mask.astype(jnp.float32)
+        return obs._replace(bands=BandBatch(
+            y=y, r_inv=bands.r_inv, mask=bands.mask,
+        ))
 
     def _note_degraded(self, date, exc: BaseException) -> None:
         """Record one degraded date (counter + event + budget check)."""
@@ -339,6 +367,14 @@ class KalmanFilter:
             "observation read for %s degraded after retries (%r); "
             "treating as a missing observation (%d of %s budget)",
             date, exc, self._degraded_count, self.max_degraded_dates,
+        )
+        # The quality ledger keeps the hole visible: a thinned series
+        # is itself a quality signal (BASELINE.md "Assimilation
+        # quality").
+        ctx = tracing.current_context()
+        quality.get_ledger(reg).record_missing(
+            date, reason="degraded_read",
+            prefix=None if ctx is None else ctx.chunk_id,
         )
         if self.max_degraded_dates is not None and \
                 self._degraded_count > self.max_degraded_dates:
@@ -532,6 +568,28 @@ class KalmanFilter:
             ).set(rec["converged_frac"])
         if "quarantined" in rec:
             self._record_solver_health(reg, rec)
+        # Quality ledger: the window's consistency record, built from
+        # the SAME host-side scalars (the packed read already paid) —
+        # zero added device transfers.  The verdict is folded back into
+        # the diagnostics record so serve responses can report it.
+        ctx = tracing.current_context()
+        entry = quality.get_ledger(reg).record_window(
+            date=rec["date"],
+            chi2_per_band=rec["chi2_per_band"],
+            n_valid=self.gather.n_valid,
+            solver_health=(
+                {
+                    "quarantined": rec["quarantined"],
+                    "cap_bailouts": rec["cap_bailouts"],
+                    "damped_recovered": rec["damped_recovered"],
+                    "nonfinite": rec["nonfinite"],
+                } if "quarantined" in rec else None
+            ),
+            prefix=None if ctx is None else ctx.chunk_id,
+            fused=rec.get("fused"),
+        )
+        rec["quality_verdict"] = entry["verdict"]
+        rec["quality_drift"] = entry["drift"]["active"]
         reg.emit(
             "solve",
             **{k: (str(v) if k == "date" else v) for k, v in rec.items()},
@@ -1085,6 +1143,7 @@ class KalmanFilter:
         self._pending_obs = {}
         self._degraded_pending = set()
         self._degraded_count = 0
+        self._obs_date_no = 0
         self._windows_since_ckpt = 0
         idx = 0
         while idx < len(windows):

@@ -46,6 +46,14 @@ In-repo sites:
                         raised: the armed pixels must come back
                         QA-quarantined through the solve-health path
                         (``core.solver_health``)
+``obs.bias``            scripted ADDITIVE BIAS on observations — the
+                        calls grammar addresses 1-based fetch-order
+                        date numbers, and nothing is raised: the armed
+                        dates' valid observations gain
+                        ``telemetry.quality.OBS_BIAS_VALUE``, which the
+                        quality ledger's drift sentinels must flag
+                        (verdict flip + ``quality_drift`` event) while
+                        unbiased dates stay bit-identical
 ================== ====================================================
 
 Scripting from tests::

@@ -30,6 +30,14 @@ Prometheus export read:
                                 a degraded fleet sheds load instead of
                                 queueing work the dead capacity was
                                 meant to absorb
+``kafka_quality_drift_active``  per-(tile, band) chi^2-ratio series in
+                                a drift-sentinel alarm
+                                (``telemetry.quality``) — a
+                                statistically inconsistent filter is
+                                serving wrong uncertainties, and an
+                                operator may prefer explicit rejection
+                                (reason ``quality_degraded``) over
+                                quietly shipping them
 =============================== =====================================
 
 Every decision is explicit: admitted requests count into
@@ -65,6 +73,11 @@ class AdmissionPolicy:
     #: dead hosts than this; None disables the signal (the default — it
     #: only means something when the daemon refreshes the fleet gauge).
     max_dead_hosts: Optional[int] = None
+    #: shed (reason ``quality_degraded``) while any quality drift
+    #: sentinel is alarming (``kafka_quality_drift_active`` > 0).  Off
+    #: by default: most operators want degraded answers SERVED and
+    #: labelled (the response's ``quality`` field), not refused.
+    shed_on_quality_drift: bool = False
 
 
 class AdmissionController:
@@ -97,4 +110,8 @@ class AdmissionController:
             dead = reg.value("kafka_fleet_dead_hosts")
             if dead is not None and dead > pol.max_dead_hosts:
                 return "fleet_degraded"
+        if pol.shed_on_quality_drift:
+            drifting = reg.value("kafka_quality_drift_active")
+            if drifting:
+                return "quality_degraded"
         return None

@@ -44,7 +44,7 @@ from typing import Callable, List, Optional, Tuple
 import numpy as np
 
 from ..engine.checkpoint import Checkpointer
-from ..telemetry import get_registry, span
+from ..telemetry import get_registry, quality, span, tracing
 
 LOG = logging.getLogger(__name__)
 
@@ -112,6 +112,15 @@ class TileSession:
         body (status/served_from/summary fields, JSON-serialisable)."""
         t0 = time.perf_counter()
         kf, x0, p_inv0, output = self.spec.make_filter()
+        # Tile-scoped trace/quality context: the quality ledger keys its
+        # sentinel streams by chunk_id, so each tile keeps its own
+        # per-band chi^2 series (the serving analogue of a chunk).
+        with tracing.push(chunk_id=f"tile:{self.name}"):
+            return self._serve_in_context(
+                kf, x0, p_inv0, output, date, t0,
+            )
+
+    def _serve_in_context(self, kf, x0, p_inv0, output, date, t0) -> dict:
         try:
             if date not in set(kf.observations.dates):
                 raise UnknownDateError(
@@ -164,6 +173,7 @@ class TileSession:
         self.serves += 1
         wall_ms = (time.perf_counter() - t0) * 1e3
         health = self._solver_health(kf)
+        qual = self._quality(kf)
         self._record(served_from, windows_run, wall_ms, health)
         return {
             "status": "ok",
@@ -182,6 +192,31 @@ class TileSession:
             # can see a degraded answer for what it is.  A warm_noop /
             # cache-style serve runs zero windows, so the totals are 0.
             "solver_health": health,
+            # Filter-consistency verdict for the windows THIS request
+            # ran (BASELINE.md "Assimilation quality"): worst verdict
+            # over the run's quality-ledger records, plus whether this
+            # tile's drift sentinels are currently alarming.  A
+            # zero-window serve (warm_noop) has no verdict.
+            "quality": qual,
+        }
+
+    def _quality(self, kf) -> dict:
+        """The run's quality summary from the engine's diagnostics log
+        (the verdicts were computed by the quality ledger during the
+        run — this reads host state only)."""
+        verdicts = [r["quality_verdict"] for r in kf.diagnostics_log
+                    if "quality_verdict" in r]
+        windows: dict = {}
+        for v in verdicts:
+            windows[v] = windows.get(v, 0) + 1
+        drifting = sorted(
+            key for key in quality.get_ledger().summary()["drifting"]
+            if key.startswith(f"tile:{self.name}:")
+        )
+        return {
+            "verdict": quality.worst_verdict(verdicts),
+            "windows": windows,
+            "drift_active": bool(drifting),
         }
 
     @staticmethod

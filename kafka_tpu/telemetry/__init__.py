@@ -30,14 +30,18 @@ scheduler, the output writers, the CLI drivers and ``bench.py``:
 - :mod:`aggregate` — the fleet plane's read side: live snapshots merged
   into one fleet view (counters summed, gauges per-host, histograms
   into fleet p50/p99, stale heartbeats flagged dead) and per-process
-  ``trace.json`` fragments stitched into one Chrome trace.
+  ``trace.json`` fragments stitched into one Chrome trace;
+- :mod:`quality` — assimilation-quality observability: the per-window
+  innovation-consistency ledger (``quality.jsonl``), filter-consistency
+  verdicts, EWMA/CUSUM drift sentinels, and the ``obs.bias`` chaos
+  site (BASELINE.md "Assimilation quality").
 
 See BASELINE.md "Observability" for metric names, label conventions, the
 event schema, and "Tracing & crash forensics" for the trace/crash
 artifacts.
 """
 
-from . import flight_recorder, live, tracing
+from . import flight_recorder, live, quality, tracing
 from .compilemon import install_compile_listeners
 from .device import fetch_scalars, record_memory_watermark
 from .registry import (
@@ -57,6 +61,7 @@ __all__ = [
     "get_registry",
     "install_compile_listeners",
     "live",
+    "quality",
     "record_memory_watermark",
     "set_registry",
     "span",

@@ -226,6 +226,9 @@ def aggregate_fleet(snapshots: List[dict], now: Optional[float] = None,
             "final": bool(snap.get("final")),
             "dead": dead,
             "unhealthy": (snap.get("health") or {}).get("unhealthy"),
+            # Per-host assimilation-quality summary (telemetry.quality;
+            # absent on pre-quality snapshots).
+            "quality": snap.get("quality"),
             "crash_dumps": list(snap.get("crash_dumps") or ()),
             "status": snap.get("status") or {},
             "path": snap.get("_rel") or snap.get("_path"),
@@ -273,6 +276,18 @@ def aggregate_fleet(snapshots: List[dict], now: Optional[float] = None,
                 )
                 entry[field] = None if v is None else round(v, 6)
         histograms[tag] = entry
+    # Fleet quality roll-up: which hosts' drift sentinels are alarming
+    # and the distribution of last verdicts — the science-side health
+    # column of the fleet view (dead_hosts is the process-side one).
+    verdict_counts: Dict[str, int] = {}
+    drifting_workers = []
+    for w in workers:
+        q = w.get("quality") or {}
+        if q.get("drift_active"):
+            drifting_workers.append(w["key"])
+        v = q.get("last_verdict")
+        if v:
+            verdict_counts[v] = verdict_counts.get(v, 0) + 1
     return {
         "generated_ts": round(now, 6),
         "n_workers": len(workers),
@@ -284,6 +299,10 @@ def aggregate_fleet(snapshots: List[dict], now: Optional[float] = None,
         "gauges": gauges,
         "histograms": histograms,
         "crash_dumps": crash_dumps,
+        "quality": {
+            "drifting_workers": sorted(drifting_workers),
+            "last_verdicts": verdict_counts,
+        },
     }
 
 

@@ -41,7 +41,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
-from . import tracing
+from . import quality, tracing
 from .live import build_snapshot, crash_dump_index
 from .registry import MetricsRegistry, get_registry
 
@@ -198,6 +198,9 @@ class TelemetryHTTPd:
             "metric_series": (len(snap["counters"]) + len(snap["gauges"])
                               + len(snap["histograms"])),
             "solver_health": solver,
+            # Assimilation-quality verdicts (telemetry.quality): the
+            # science-side health next to the process-side one.
+            "quality": quality.summary(reg),
             "crash_dumps": crash_dump_index(reg.directory),
             "status": status,
         })

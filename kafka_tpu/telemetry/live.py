@@ -39,7 +39,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from . import tracing
+from . import quality, tracing
 from .registry import MetricsRegistry, _label_text, get_registry
 
 #: snapshot schema version (bumped on breaking changes; consumers skip
@@ -144,6 +144,9 @@ def build_snapshot(registry: Optional[MetricsRegistry] = None,
         "health": {
             "unhealthy": None if unhealthy is None else bool(unhealthy),
         },
+        # Assimilation-quality verdicts (telemetry.quality): the fleet
+        # view folds these into per-host quality columns.
+        "quality": quality.summary(reg),
         "counters": counters,
         "gauges": gauges,
         "histograms": histograms,

@@ -30,7 +30,7 @@ EXPECTED_KEYS = [
     "live_telemetry",
     "probe_device_ms", "probe_host_ms", "probe_retried",
     "unhealthy_reasons", "probe_host_after_ms", "unhealthy",
-    "telemetry", "solver_health",
+    "telemetry", "solver_health", "quality",
 ]
 
 HEALTH_KEYS = {
@@ -131,6 +131,35 @@ class TestBenchArtifactSchema:
             "nonfinite", "clip_saturated",
         }
         assert all(v == 0 for v in clean["solver_health"].values())
+
+    def test_quality_snapshot_always_present(self):
+        """The assimilation-quality snapshot rides every artifact (a
+        null verdict + zero window counts on a run that recorded no
+        quality windows) so bench_compare can diff consistency without
+        special-casing missing keys — the solver_health twin."""
+        from kafka_tpu.telemetry import quality as q
+
+        with telemetry.use(MetricsRegistry()) as reg:
+            _, clean = _assemble(reg)
+        snap = clean["quality"]
+        assert set(snap) == {
+            "verdict", "windows", "drift_events", "drift_active",
+        }
+        assert snap["verdict"] is None
+        assert set(snap["windows"]) == set(q.VERDICTS)
+        assert all(v == 0 for v in snap["windows"].values())
+        assert snap["drift_events"] == 0 and snap["drift_active"] == 0
+        # A run that recorded windows carries their verdict counts and
+        # the worst verdict as the overall one.
+        with telemetry.use(MetricsRegistry()) as reg:
+            led = q.get_ledger(reg)
+            led.record_window("2021-01-01", [0.9, 1.1], n_valid=10)
+            led.record_window("2021-01-02", [44.0, 1.0], n_valid=10)
+            _, result = _assemble(reg)
+        snap = result["quality"]
+        assert snap["windows"][q.CONSISTENT] == 1
+        assert snap["windows"][q.OVERCONFIDENT] == 1
+        assert snap["verdict"] == q.OVERCONFIDENT
 
     def test_json_serialisable_one_line(self):
         with telemetry.use(MetricsRegistry()) as reg:

@@ -1,0 +1,853 @@
+"""Assimilation-quality observability (ISSUE 11): the innovation-
+consistency ledger, verdicts, drift sentinels, the obs.bias chaos
+site, the quality_report scorecard, and the outward wiring (serve
+responses, admission shedding, statusz/live/fleet views, fleet_status
+--watch).
+
+The chaos acceptance test pins the contract end to end: a run with
+``obs.bias`` armed on k trailing dates is flagged by the drift
+sentinel on exactly those dates (verdict flips + ``quality_drift``
+events), while unbiased dates' outputs stay BIT-IDENTICAL to a
+fault-free run — and the ledger costs zero additional device->host
+transfers (``kafka_engine_device_reads_total == dispatches``
+re-asserted with the ledger active).
+"""
+
+import datetime
+import json
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from kafka_tpu import telemetry
+from kafka_tpu.telemetry import MetricsRegistry, quality
+from kafka_tpu.resilience import faults
+
+
+def day(i):
+    return datetime.datetime(2017, 7, 1) + datetime.timedelta(days=i)
+
+
+def run_identity_engine(telemetry_dir=None, scan_window=1,
+                        prefetch_depth=2):
+    """A small identity-operator engine run whose clean chi^2 ratios
+    idle near 1 (the textbook-consistent configuration): 8 observation
+    dates, grid of 5 windows.  Returns ``(kf, out, reg)``."""
+    import jax.numpy as jnp
+
+    from kafka_tpu.core.propagators import (
+        PixelPrior, propagate_information_filter_approx,
+    )
+    from kafka_tpu.engine import FixedGaussianPrior, KalmanFilter
+    from kafka_tpu.obsops.identity import IdentityOperator
+    from kafka_tpu.testing.fixtures import make_pivot_mask
+    from kafka_tpu.testing.synthetic import (
+        MemoryOutput, SyntheticObservations,
+    )
+
+    mask = make_pivot_mask(20, 20, seed=0)
+    p = 2
+    op = IdentityOperator(n_params=p, obs_indices=(0, 1))
+    cov = np.diag(np.full(p, 0.4 ** 2)).astype(np.float32)
+    prior = FixedGaussianPrior(
+        PixelPrior(
+            mean=jnp.full((p,), 0.5, jnp.float32),
+            cov=jnp.asarray(cov),
+            inv_cov=jnp.asarray(np.linalg.inv(cov)),
+        ),
+        ("a", "b"),
+    )
+    truth = np.broadcast_to(
+        np.array([0.3, 0.7], np.float32), mask.shape + (2,)
+    ).astype(np.float32)
+    with telemetry.use(MetricsRegistry(telemetry_dir)) as reg:
+        obs = SyntheticObservations(
+            dates=[day(i) for i in range(1, 16, 2)], operator=op,
+            truth_fn=lambda d: truth, sigma=0.02, mask_prob=0.1, seed=0,
+        )
+        out = MemoryOutput()
+        kf = KalmanFilter(
+            obs, out, mask, ("a", "b"),
+            state_propagation=propagate_information_filter_approx,
+            prior=None, solver_options={"relaxation": 0.5},
+            scan_window=scan_window, prefetch_depth=prefetch_depth,
+        )
+        kf.set_trajectory_model()
+        kf.set_trajectory_uncertainty(np.full(p, 1e-3, np.float32))
+        x0, p_inv0 = prior.process_prior(None, kf.gather)
+        kf.run([day(i) for i in range(0, 20, 4)], x0, None, p_inv0)
+    return kf, out, reg
+
+
+# ---------------------------------------------------------------------------
+# Verdicts.
+# ---------------------------------------------------------------------------
+
+class TestVerdicts:
+    def test_bands(self):
+        assert quality.verdict_for([0.9, 1.1]) == quality.CONSISTENT
+        assert quality.verdict_for([0.9, 40.0]) == quality.OVERCONFIDENT
+        assert quality.verdict_for([0.004, 1.0]) == \
+            quality.UNDERCONFIDENT
+        # Over wins over under: an exploded band is the louder signal.
+        assert quality.verdict_for([0.001, 99.0]) == \
+            quality.OVERCONFIDENT
+
+    def test_no_signal_bands_are_skipped(self):
+        # 0 = fully-masked band (no observations), NaN = no signal.
+        assert quality.verdict_for([]) == quality.NO_OBS
+        assert quality.verdict_for([0.0, 0.0]) == quality.NO_OBS
+        assert quality.verdict_for([float("nan"), 1.0]) == \
+            quality.CONSISTENT
+        assert quality.verdict_for([0.0, 30.0]) == quality.OVERCONFIDENT
+
+    def test_custom_bands(self):
+        assert quality.verdict_for([1.8], hi=1.5) == \
+            quality.OVERCONFIDENT
+        assert quality.verdict_for([0.3], lo=0.5) == \
+            quality.UNDERCONFIDENT
+
+    def test_worst_verdict_severity(self):
+        q = quality
+        assert q.worst_verdict([]) is None
+        assert q.worst_verdict([q.CONSISTENT, q.NO_OBS]) == q.NO_OBS
+        assert q.worst_verdict(
+            [q.CONSISTENT, q.UNDERCONFIDENT, q.NO_OBS]
+        ) == q.UNDERCONFIDENT
+        assert q.worst_verdict(
+            [q.OVERCONFIDENT, q.UNDERCONFIDENT]
+        ) == q.OVERCONFIDENT
+
+
+# ---------------------------------------------------------------------------
+# Drift sentinels.
+# ---------------------------------------------------------------------------
+
+class TestDriftSentinel:
+    def test_calibration_never_alarms(self):
+        s = quality.DriftSentinel(window=3)
+        for x in (1.0, 80.0, 0.01):
+            st = s.update(x)
+            assert st["phase"] == "calibrating"
+            assert not st["drifting"]
+
+    def test_step_change_alarms_and_sustains_then_heals(self):
+        s = quality.DriftSentinel(window=4)
+        for _ in range(6):
+            st = s.update(1.0)
+            assert not st["drifting"]
+        st = s.update(50.0)  # log-dev ~3.9 >> h_high
+        assert st["drifting"] and st["trigger"] == "cusum_high"
+        # NO reset-after-alarm: a sustained fault stays flagged on
+        # every affected date even as its magnitude decays...
+        st = s.update(20.0)
+        assert st["drifting"] and st["trigger"] == "cusum_high"
+        # ...and the first clean date flushes the episode (the alarm
+        # samples never entered the baseline window).
+        st = s.update(1.0)
+        assert not st["drifting"]
+        assert s.cusum_pos == 0.0
+
+    def test_downward_shift_alarms_low_side(self):
+        s = quality.DriftSentinel(window=4)
+        for _ in range(4):
+            s.update(1.0)
+        s.update(0.05)  # accumulates but below h_low
+        st = s.update(0.05)
+        assert st["drifting"] and st["trigger"] == "cusum_low"
+
+    def test_self_baselining_accepts_low_operating_level(self):
+        """A tight-prior configuration idling near 0.05 (the TIP
+        problem) is ITS OWN baseline — no alarms on a stationary
+        series, which an absolute target-1 CUSUM would false-flag."""
+        s = quality.DriftSentinel()
+        for x in (0.051, 0.042, 0.041, 0.054) * 6:
+            st = s.update(x)
+            assert not st["drifting"], st
+
+    def test_spin_up_decay_is_absorbed_not_flagged(self):
+        """The filter's spin-up transient — posterior chi^2 starting
+        high and decaying to its settled level (observed on the
+        run_synthetic identity driver: 6.4, 4.6, 1.4, 0.8 then ~0.5) —
+        must NOT read as drift: the rolling baseline window follows
+        the decay instead of freezing over the transient head."""
+        s = quality.DriftSentinel()
+        series = [6.38, 4.63, 1.39, 0.80, 0.595, 0.52, 0.524, 0.52,
+                  0.55, 0.50, 0.53]
+        for x in series:
+            st = s.update(x)
+            assert not st["drifting"], (x, st)
+
+    def test_ewma_flags_sustained_moderate_shift(self):
+        s = quality.DriftSentinel(window=6, k=10.0, h_high=1e9,
+                                  h_low=1e9)
+        # CUSUM disabled by its slack/threshold: only the EWMA watches.
+        for _ in range(6):
+            s.update(1.0)
+        triggers = [s.update(100.0)["trigger"] for _ in range(8)]
+        assert "ewma" in triggers
+
+
+# ---------------------------------------------------------------------------
+# The ledger.
+# ---------------------------------------------------------------------------
+
+class TestLedger:
+    def test_records_metrics_and_jsonl(self, tmp_path):
+        d = str(tmp_path)
+        with telemetry.use(MetricsRegistry(d)) as reg:
+            led = quality.get_ledger(reg)
+            assert led is quality.get_ledger(reg)  # one per registry
+            r1 = led.record_window(day(1), [0.9, 1.2], n_valid=64)
+            r2 = led.record_window(
+                day(2), [30.0, 1.0], n_valid=64,
+                solver_health={"quarantined": 3}, prefix="0001",
+            )
+            r3 = led.record_missing(day(3), prefix="0001")
+            assert r1["verdict"] == quality.CONSISTENT
+            assert r2["verdict"] == quality.OVERCONFIDENT
+            assert r2["solver_health"] == {"quarantined": 3}
+            assert r3["verdict"] == quality.NO_OBS and r3["degraded"]
+            assert reg.value(
+                "kafka_quality_windows_total",
+                verdict=quality.CONSISTENT,
+            ) == 1
+            assert reg.value(
+                "kafka_quality_windows_total", verdict=quality.NO_OBS,
+            ) == 1
+        records, skipped = quality.load_ledger(
+            os.path.join(d, quality.LEDGER_FILENAME)
+        )
+        assert skipped == 0
+        assert [r["verdict"] for r in records] == [
+            quality.CONSISTENT, quality.OVERCONFIDENT, quality.NO_OBS,
+        ]
+        assert records[1]["prefix"] == "0001"
+        assert records[0]["schema"] == quality.LEDGER_SCHEMA
+
+    def test_in_memory_without_directory(self):
+        with telemetry.use(MetricsRegistry()) as reg:
+            led = quality.get_ledger(reg)
+            led.record_window(day(1), [1.0], n_valid=4)
+            assert led.path is None
+            assert led.summary()["records"] == 1
+
+    def test_sentinel_streams_keyed_by_prefix_and_band(self):
+        """Two chunks' (or tiles') series must not pollute each other:
+        a chunk idling at 0.05 next to one idling at 1.0 is two
+        healthy baselines, not a drift."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            led = quality.get_ledger(reg)
+            for _ in range(10):
+                ra = led.record_window(day(1), [0.05], n_valid=4,
+                                       prefix="a")
+                rb = led.record_window(day(1), [1.0], n_valid=4,
+                                       prefix="b")
+                assert not ra["drift"]["active"]
+                assert not rb["drift"]["active"]
+            # A jump on stream b alarms b alone.
+            rb = led.record_window(day(2), [60.0], n_valid=4,
+                                   prefix="b")
+            assert rb["drift"]["active"]
+            assert reg.value("kafka_quality_drift_active") == 1
+            assert led.summary()["drifting"] == ["b:band0"]
+
+    def test_drift_gauge_clears_when_series_heals(self):
+        with telemetry.use(MetricsRegistry()) as reg:
+            led = quality.get_ledger(reg)
+            for _ in range(6):
+                led.record_window(day(1), [1.0], n_valid=4)
+            led.record_window(day(2), [70.0], n_valid=4)
+            assert reg.value("kafka_quality_drift_active") == 1
+            for _ in range(6):
+                led.record_window(day(3), [1.0], n_valid=4)
+            assert reg.value("kafka_quality_drift_active") == 0
+
+    def test_torn_tail_skipped(self, tmp_path):
+        path = tmp_path / quality.LEDGER_FILENAME
+        with telemetry.use(MetricsRegistry(str(tmp_path))) as reg:
+            led = quality.get_ledger(reg)
+            led.record_window(day(1), [1.0], n_valid=4)
+            led.record_window(day(2), [1.1], n_valid=4)
+        # A process killed mid-append leaves a torn final line.
+        with open(path, "a") as f:
+            f.write('{"schema": 1, "date": "2017-07-0')
+        records, skipped = quality.load_ledger(str(path))
+        assert len(records) == 2
+        assert skipped == 1
+
+    def test_non_record_lines_skipped(self, tmp_path):
+        path = tmp_path / quality.LEDGER_FILENAME
+        path.write_text('42\n{"no_verdict": true}\n'
+                        '{"verdict": "CONSISTENT", "date": "d"}\n')
+        records, skipped = quality.load_ledger(str(path))
+        assert len(records) == 1 and skipped == 2
+
+
+# ---------------------------------------------------------------------------
+# Engine integration: the ledger rides the existing packed read.
+# ---------------------------------------------------------------------------
+
+class TestEngineQuality:
+    def test_ledger_written_with_zero_added_device_reads(self, tmp_path):
+        """THE invariant, re-asserted with the quality ledger active:
+        one packed device->host read per solve dispatch, ledger or no
+        ledger — the quality record is built from scalars the engine
+        already fetched."""
+        for scan_window in (1, 4):
+            d = str(tmp_path / f"sw{scan_window}")
+            kf, out, reg = run_identity_engine(
+                telemetry_dir=d, scan_window=scan_window,
+            )
+            dispatches = sum(
+                1.0 / rec.get("fused", 1) for rec in kf.diagnostics_log
+            )
+            assert reg.value("kafka_engine_device_reads_total") == \
+                int(dispatches)
+            records, skipped = quality.load_ledger(
+                os.path.join(d, quality.LEDGER_FILENAME)
+            )
+            assert skipped == 0
+            assert len(records) == len(kf.diagnostics_log)
+            for rec, led in zip(kf.diagnostics_log, records):
+                assert rec["quality_verdict"] == led["verdict"]
+                assert led["chi2_per_band"] == pytest.approx(
+                    rec["chi2_per_band"], abs=1e-6,
+                )
+                assert led["n_valid"] == kf.gather.n_valid
+            # The clean identity configuration is textbook-consistent.
+            assert all(
+                r["verdict"] == quality.CONSISTENT for r in records
+            )
+            assert all(not r["drift"]["active"] for r in records)
+
+    def test_degraded_date_lands_as_missing_record(self, tmp_path):
+        from kafka_tpu.resilience import RetryPolicy
+
+        d = str(tmp_path)
+        faults.reset()
+        try:
+            faults.script("prefetch.read_date", "3", faults.TRANSIENT)
+            import jax.numpy as jnp
+
+            from kafka_tpu.core.propagators import (
+                PixelPrior, propagate_information_filter_approx,
+            )
+            from kafka_tpu.engine import (
+                FixedGaussianPrior, KalmanFilter,
+            )
+            from kafka_tpu.obsops.identity import IdentityOperator
+            from kafka_tpu.testing.fixtures import make_pivot_mask
+            from kafka_tpu.testing.synthetic import (
+                MemoryOutput, SyntheticObservations,
+            )
+
+            mask = make_pivot_mask(12, 12, seed=0)
+            op = IdentityOperator(n_params=2, obs_indices=(0, 1))
+            cov = np.diag(np.full(2, 0.16)).astype(np.float32)
+            prior = FixedGaussianPrior(
+                PixelPrior(
+                    mean=jnp.full((2,), 0.5, jnp.float32),
+                    cov=jnp.asarray(cov),
+                    inv_cov=jnp.asarray(np.linalg.inv(cov)),
+                ),
+                ("a", "b"),
+            )
+            truth = np.broadcast_to(
+                np.array([0.3, 0.7], np.float32), mask.shape + (2,)
+            ).astype(np.float32)
+            with telemetry.use(MetricsRegistry(d)) as reg:
+                obs = SyntheticObservations(
+                    dates=[day(i) for i in (1, 3, 5)], operator=op,
+                    truth_fn=lambda dd: truth, sigma=0.02, seed=0,
+                )
+                kf = KalmanFilter(
+                    obs, MemoryOutput(), mask, ("a", "b"),
+                    state_propagation=(
+                        propagate_information_filter_approx
+                    ),
+                    prior=None, prefetch_depth=0,
+                    read_retry_policy=RetryPolicy(
+                        max_attempts=1, base_delay=0.0,
+                    ),
+                )
+                kf.set_trajectory_model()
+                kf.set_trajectory_uncertainty(
+                    np.full(2, 1e-3, np.float32)
+                )
+                x0, p_inv0 = prior.process_prior(None, kf.gather)
+                kf.run([day(0), day(2), day(4), day(6)], x0, None,
+                       p_inv0)
+        finally:
+            faults.reset()
+        records, _ = quality.load_ledger(
+            os.path.join(d, quality.LEDGER_FILENAME)
+        )
+        degraded = [r for r in records if r["degraded"]]
+        assert len(degraded) == 1
+        assert degraded[0]["verdict"] == quality.NO_OBS
+        assert reg.value(
+            "kafka_quality_windows_total", verdict=quality.NO_OBS,
+        ) == 1
+
+
+# ---------------------------------------------------------------------------
+# The obs.bias chaos acceptance.
+# ---------------------------------------------------------------------------
+
+class TestObsBiasChaos:
+    def test_bias_grammar_parses_from_env_spec(self):
+        specs = faults.parse_spec("obs.bias@7-8")
+        assert specs[0].site == "obs.bias"
+        assert specs[0].first == 7 and specs[0].last == 8
+
+    def test_disarmed_bias_is_none(self):
+        faults.reset()
+        assert quality.observation_bias(1) is None
+        faults.script("solver.pixel", "1-2")  # some OTHER site armed
+        try:
+            assert quality.observation_bias(1) is None
+        finally:
+            faults.reset()
+
+    def test_armed_dates_flagged_clean_dates_bit_identical(
+            self, tmp_path):
+        """THE acceptance: obs.bias armed on the two trailing
+        observation dates (fetch numbers 7-8 of 8).  The drift sentinel
+        flags exactly those dates' ledger records (verdict flips to
+        OVERCONFIDENT + quality_drift events), every clean date stays
+        CONSISTENT with no drift, and every output timestep before the
+        armed dates is BIT-IDENTICAL to the fault-free run."""
+        faults.reset()
+        clean_dir = str(tmp_path / "clean")
+        bias_dir = str(tmp_path / "bias")
+        kf_c, out_c, reg_c = run_identity_engine(
+            telemetry_dir=clean_dir
+        )
+        faults.script("obs.bias", "7-8")
+        try:
+            kf_b, out_b, reg_b = run_identity_engine(
+                telemetry_dir=bias_dir
+            )
+        finally:
+            faults.reset()
+        recs_c, _ = quality.load_ledger(
+            os.path.join(clean_dir, quality.LEDGER_FILENAME)
+        )
+        recs_b, _ = quality.load_ledger(
+            os.path.join(bias_dir, quality.LEDGER_FILENAME)
+        )
+        assert len(recs_b) == len(recs_c) == 8
+        armed_dates = {str(day(13)), str(day(15))}  # fetch #7 and #8
+        for rc, rb in zip(recs_c, recs_b):
+            assert rb["date"] == rc["date"]
+            if rb["date"] in armed_dates:
+                assert rb["verdict"] == quality.OVERCONFIDENT
+                assert rb["drift"]["active"], rb
+            else:
+                assert rb["verdict"] == quality.CONSISTENT
+                assert not rb["drift"]["active"]
+                # Unbiased windows: identical scalars too.
+                assert rb["chi2_per_band"] == rc["chi2_per_band"]
+        # quality_drift events fired on exactly the armed dates.
+        ev_dates = {
+            e["date"] for e in reg_b.events
+            if e["event"] == "quality_drift"
+        }
+        assert ev_dates == armed_dates
+        assert not any(
+            e["event"] == "quality_drift" for e in reg_c.events
+        )
+        assert reg_b.value("kafka_quality_drift_active") >= 1
+        assert reg_b.value(
+            "kafka_resilience_faults_injected_total", site="obs.bias",
+        ) == 2
+        # Clean-date outputs bit-identical: the bias only enters armed
+        # dates' y, and those land in the LAST grid window.
+        timesteps = sorted(out_c.output)
+        assert len(timesteps) == 4  # 5 grid points -> 4 dumped windows
+        biased_windows = {timesteps[-1]}
+        for ts in timesteps:
+            for key, arr in out_c.output[ts].items():
+                same = np.array_equal(
+                    arr, out_b.output[ts][key], equal_nan=True,
+                )
+                if ts in biased_windows:
+                    continue  # the armed window legitimately differs
+                assert same, f"{ts} {key} differs on an unbiased window"
+        # ... and the armed window's state DID move (the bias is real).
+        last = timesteps[-1]
+        assert not np.array_equal(
+            out_c.output[last]["a"], out_b.output[last]["a"],
+            equal_nan=True,
+        )
+
+    def test_device_reads_invariant_under_chaos(self, tmp_path):
+        """Arming obs.bias adds zero device reads: the bias rides the
+        traced y data, the ledger rides the packed read."""
+        faults.reset()
+        faults.script("obs.bias", "7-8")
+        try:
+            kf, out, reg = run_identity_engine(
+                telemetry_dir=str(tmp_path)
+            )
+        finally:
+            faults.reset()
+        assert reg.value("kafka_engine_device_reads_total") == \
+            len(kf.diagnostics_log)
+
+
+class TestRunSyntheticLedger:
+    def test_driver_writes_quality_ledger_under_env_chaos(
+            self, tmp_path, monkeypatch):
+        """Acceptance plumbing: the run_synthetic driver (telemetry-dir
+        configured, KAFKA_TPU_FAULTS env spec) writes quality.jsonl,
+        and the env-armed obs.bias dates come back flagged."""
+        from kafka_tpu.cli.run_synthetic import main
+        from kafka_tpu.telemetry import get_registry, set_registry
+
+        tel = str(tmp_path / "tel")
+        monkeypatch.setenv("KAFKA_TPU_FAULTS", "obs.bias@7-8")
+        prev = get_registry()
+        faults.reset()
+        try:
+            summary = main([
+                "--operator", "identity", "--ny", "40", "--nx", "40",
+                "--outdir", str(tmp_path / "out"),
+                "--telemetry-dir", tel,
+            ])
+        finally:
+            faults.reset()
+            set_registry(prev)
+        assert summary["n_pixels"] > 0
+        records, skipped = quality.load_ledger(
+            os.path.join(tel, quality.LEDGER_FILENAME)
+        )
+        assert skipped == 0
+        assert len(records) == summary["n_dates"] == 8
+        flagged = [r for r in records if r["drift"]["active"]]
+        assert [r["date"] for r in flagged] == \
+            [str(day(13)), str(day(15))]
+        assert all(
+            r["verdict"] == quality.OVERCONFIDENT for r in flagged
+        )
+
+
+# ---------------------------------------------------------------------------
+# quality_report: the scorecard CLI.
+# ---------------------------------------------------------------------------
+
+class TestQualityReport:
+    def _ledger_dir(self, tmp_path, name="run"):
+        d = tmp_path / name
+        d.mkdir()
+        with telemetry.use(MetricsRegistry(str(d))) as reg:
+            led = quality.get_ledger(reg)
+            for i in range(6):
+                led.record_window(day(i), [1.0, 0.9], n_valid=10)
+            led.record_window(day(6), [55.0, 1.0], n_valid=10)
+            led.record_missing(day(7))
+        return d
+
+    def test_json_reproduces_verdicts_from_ledger_alone(
+            self, tmp_path, capsys):
+        from tools import quality_report
+
+        d = self._ledger_dir(tmp_path)
+        rc = quality_report.main([str(d), "--json"])
+        assert rc == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["version"] == 1
+        assert payload["bands"] == {
+            "lo": quality.CONSISTENT_LO, "hi": quality.CONSISTENT_HI,
+        }
+        (tile,) = payload["tiles"].values()
+        assert len(tile["dates"]) == 8
+        for entry in tile["dates"]:
+            # Acceptance: per-date verdicts reproduce from the ledger
+            # alone (recomputed from the stored ratios with the same
+            # bands).
+            assert entry["recomputed"] == entry["verdict"]
+        assert tile["overall"] == quality.OVERCONFIDENT
+        assert tile["verdicts"][quality.CONSISTENT] == 6
+        assert tile["verdicts"][quality.NO_OBS] == 1
+        assert tile["drift_dates"] == 1
+        assert len(tile["episodes"]) == 1
+        assert tile["episodes"][0]["start"] == str(day(6))
+        assert tile["worst"][0]["date"] == str(day(6))
+
+    def test_torn_tail_counted_not_fatal(self, tmp_path, capsys):
+        from tools import quality_report
+
+        d = self._ledger_dir(tmp_path)
+        with open(d / quality.LEDGER_FILENAME, "a") as f:
+            f.write('{"torn": ')
+        rc = quality_report.main([str(d), "--json"])
+        assert rc == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["sources"][0]["skipped_lines"] == 1
+        assert payload["sources"][0]["records"] == 8
+
+    def test_multiple_ledgers_and_prefix_grouping(self, tmp_path,
+                                                  capsys):
+        from tools import quality_report
+
+        d = tmp_path / "multi"
+        d.mkdir()
+        with telemetry.use(MetricsRegistry(str(d))) as reg:
+            led = quality.get_ledger(reg)
+            led.record_window(day(0), [1.0], n_valid=4,
+                              prefix="tile:alpha")
+            led.record_window(day(0), [1.1], n_valid=4,
+                              prefix="tile:beta")
+        rc = quality_report.main([str(tmp_path), "--json"])
+        assert rc == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert set(payload["tiles"]) >= {"tile:alpha", "tile:beta"}
+
+    def test_human_render_smoke(self, tmp_path, capsys):
+        from tools import quality_report
+
+        d = self._ledger_dir(tmp_path)
+        assert quality_report.main([str(d)]) == 0
+        out = capsys.readouterr().out
+        assert "quality report" in out
+        assert "drift episode" in out
+        assert "O!" in out  # the drifting OVERCONFIDENT date's glyph
+
+    def test_no_ledger_is_usage_error(self, tmp_path, capsys):
+        from tools import quality_report
+
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert quality_report.main([str(empty)]) == 2
+        assert "no quality.jsonl" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# Serve wiring: responses, ledger, admission.
+# ---------------------------------------------------------------------------
+
+class TestServeQuality:
+    def _session(self, tmp_path):
+        from kafka_tpu.serve import TileSession, make_synthetic_tile
+
+        spec = make_synthetic_tile(
+            "tile0", ckpt_dir=str(tmp_path / "ckpt_tile0"),
+            operator="identity", ny=16, nx=16, days=8,
+        )
+        return TileSession(spec)
+
+    def test_response_carries_quality_next_to_solver_health(
+            self, tmp_path):
+        from kafka_tpu.serve.synthetic import synthetic_dates
+
+        with telemetry.use(MetricsRegistry(str(tmp_path / "tel"))):
+            sess = self._session(tmp_path)
+            dates = synthetic_dates(day(0), 8, 2)
+            body = sess.serve(dates[-1])
+            assert body["status"] == "ok"
+            assert "solver_health" in body
+            q = body["quality"]
+            assert q["verdict"] in quality.VERDICTS
+            assert sum(q["windows"].values()) >= 1
+            assert q["drift_active"] is False
+            # A warm_noop serve runs zero windows: no verdict.
+            body2 = sess.serve(dates[-1])
+            assert body2["served_from"] == "warm_noop"
+            assert body2["quality"]["verdict"] is None
+            assert body2["quality"]["windows"] == {}
+        # Acceptance: the serving path writes the same quality.jsonl
+        # ledger the batch drivers do, keyed by tile.
+        records, _ = quality.load_ledger(
+            str(tmp_path / "tel" / quality.LEDGER_FILENAME)
+        )
+        assert records
+        assert all(r["prefix"] == "tile:tile0" for r in records)
+
+    def test_admission_sheds_on_quality_drift_when_opted_in(self):
+        from kafka_tpu.serve.admission import (
+            AdmissionController, AdmissionPolicy,
+        )
+
+        with telemetry.use(MetricsRegistry()) as reg:
+            ctl = AdmissionController(
+                AdmissionPolicy(shed_on_quality_drift=True)
+            )
+            assert ctl.decide(queue_depth=0) is None
+            reg.gauge("kafka_quality_drift_active").set(2)
+            assert ctl.decide(queue_depth=0) == "quality_degraded"
+            reg.gauge("kafka_quality_drift_active").set(0)
+            assert ctl.decide(queue_depth=0) is None
+            # Default policy: drift never sheds.
+            default = AdmissionController(AdmissionPolicy())
+            reg.gauge("kafka_quality_drift_active").set(2)
+            assert default.decide(queue_depth=0) is None
+
+
+# ---------------------------------------------------------------------------
+# Observability wiring: statusz, live snapshots, fleet view, --watch.
+# ---------------------------------------------------------------------------
+
+class TestQualityObservability:
+    def test_live_snapshot_carries_quality(self):
+        from kafka_tpu.telemetry.live import build_snapshot
+
+        with telemetry.use(MetricsRegistry()) as reg:
+            led = quality.get_ledger(reg)
+            for _ in range(6):
+                led.record_window(day(1), [1.0], n_valid=4)
+            led.record_window(day(2), [70.0], n_valid=4)
+            snap = build_snapshot(reg)
+        q = snap["quality"]
+        assert q["last_verdict"] == quality.OVERCONFIDENT
+        assert q["drift_active"] == 1
+
+    def test_statusz_reports_quality(self):
+        import urllib.request
+
+        from kafka_tpu.telemetry.httpd import TelemetryHTTPd
+
+        with telemetry.use(MetricsRegistry()) as reg:
+            quality.get_ledger(reg).record_window(
+                day(1), [1.0], n_valid=4,
+            )
+            httpd = TelemetryHTTPd(port=0, registry=reg).start()
+            try:
+                with urllib.request.urlopen(
+                        httpd.url + "/statusz", timeout=5) as resp:
+                    body = json.loads(resp.read())
+            finally:
+                httpd.close()
+        assert body["quality"]["last_verdict"] == quality.CONSISTENT
+        assert body["quality"]["drift_active"] == 0
+
+    def _snap(self, ts, host, quality_summary):
+        return {
+            "schema": 1, "ts": ts, "host": host, "pid": 1,
+            "role": "engine", "seq": 1, "interval_s": 2.0,
+            "final": True, "run_id": "r", "chunk_id": None,
+            "health": {"unhealthy": None},
+            "quality": quality_summary,
+            "counters": {}, "gauges": {}, "histograms": {},
+            "series_truncated": 0, "crash_dumps": [], "status": {},
+        }
+
+    def test_fleet_view_folds_quality(self):
+        import time as _time
+
+        from kafka_tpu.telemetry.aggregate import aggregate_fleet
+
+        now = _time.time()
+        fleet = aggregate_fleet([
+            self._snap(now, "a", {
+                "last_verdict": quality.CONSISTENT, "windows": {},
+                "drift_active": 0, "drifting": [], "records": 3,
+                "ledger_path": None,
+            }),
+            self._snap(now, "b", {
+                "last_verdict": quality.OVERCONFIDENT, "windows": {},
+                "drift_active": 2, "drifting": ["-:band0"],
+                "records": 3, "ledger_path": None,
+            }),
+        ], now=now)
+        assert fleet["quality"]["drifting_workers"] == ["b:1"]
+        assert fleet["quality"]["last_verdicts"] == {
+            quality.CONSISTENT: 1, quality.OVERCONFIDENT: 1,
+        }
+        by_key = {w["key"]: w for w in fleet["workers"]}
+        assert by_key["b:1"]["quality"]["drift_active"] == 2
+
+    def test_fleet_status_renders_quality_and_watch_loops(
+            self, tmp_path, capsys):
+        from tools import fleet_status
+
+        snap = self._snap(0, "h", {
+            "last_verdict": quality.OVERCONFIDENT, "windows": {},
+            "drift_active": 1, "drifting": ["-:band0"], "records": 1,
+            "ledger_path": None,
+        })
+        snap["ts"] = __import__("time").time()
+        with open(tmp_path / "live_h_1.json", "w") as f:
+            json.dump(snap, f)
+        assert fleet_status.main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "quality=OVERCONFIDENT(DRIFT)" in out
+        assert "quality drift ACTIVE on: h:1" in out
+        # --watch N: periodic redraw; the single-iteration smoke hook.
+        rc = fleet_status.main([
+            str(tmp_path), "--watch", "0.01", "--watch-count", "2",
+        ])
+        assert rc == 0
+        watched = capsys.readouterr().out
+        assert watched.count("quality drift ACTIVE") == 2
+        assert "\x1b[2J" in watched
+
+
+# ---------------------------------------------------------------------------
+# Bench artifact + bench_compare wiring.
+# ---------------------------------------------------------------------------
+
+class TestBenchQuality:
+    def test_quality_snapshot_reads_registry(self):
+        import bench
+
+        with telemetry.use(MetricsRegistry()) as reg:
+            led = quality.get_ledger(reg)
+            for _ in range(6):
+                led.record_window(day(1), [1.0], n_valid=4)
+            led.record_window(day(2), [70.0], n_valid=4)
+            snap = bench.quality_snapshot(reg)
+        assert snap["verdict"] == quality.OVERCONFIDENT
+        assert snap["windows"][quality.CONSISTENT] == 6
+        assert snap["windows"][quality.OVERCONFIDENT] == 1
+        assert snap["drift_events"] == 1
+        assert snap["drift_active"] == 1
+
+    def _artifact(self, tmp_path, name, verdict, drift_events=0):
+        art = {
+            "device_xla_ms": 6.4,
+            "unhealthy": False,
+            "quality": {
+                "verdict": verdict,
+                "windows": {},
+                "drift_events": drift_events,
+                "drift_active": 0,
+            },
+        }
+        path = tmp_path / name
+        path.write_text(json.dumps(art))
+        return str(path)
+
+    def test_bench_compare_warns_on_verdict_flip(self, tmp_path,
+                                                 capsys):
+        from tools import bench_compare
+
+        old = self._artifact(tmp_path, "old.json", quality.CONSISTENT)
+        new = self._artifact(tmp_path, "new.json",
+                             quality.OVERCONFIDENT, drift_events=3)
+        rc = bench_compare.main([old, new])
+        captured = capsys.readouterr()
+        assert rc == 0  # informational, never a timing gate
+        assert "verdict flipped CONSISTENT -> OVERCONFIDENT" in \
+            captured.err
+        assert "drift_events went 0 -> 3" in captured.err
+        assert "assimilation-quality deltas" in captured.out
+
+    def test_bench_compare_quiet_when_consistent(self, tmp_path,
+                                                 capsys):
+        from tools import bench_compare
+
+        old = self._artifact(tmp_path, "old.json", quality.CONSISTENT)
+        new = self._artifact(tmp_path, "new.json", quality.CONSISTENT)
+        rc = bench_compare.main([old, new])
+        captured = capsys.readouterr()
+        assert rc == 0
+        assert "WARNING" not in captured.err

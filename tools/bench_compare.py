@@ -19,7 +19,12 @@ write-only.  This tool makes it actionable:
 - diffs the embedded ``"telemetry"`` registry snapshots (PR 2's compact
   counter/gauge view) and reports the largest relative changes —
   convergence iterations, device reads, compile-cache hits — so a timing
-  shift arrives with its likely cause attached.
+  shift arrives with its likely cause attached;
+- diffs the embedded ``"quality"`` snapshots (assimilation-quality
+  verdict counts + drift-sentinel state) informationally, with a LOUD
+  warning when a previously-CONSISTENT benchmark flips verdict or its
+  drift sentinels go 0 -> alarming — mirroring the ``solver_health``
+  quarantine warning.
 
 Usage:
     python tools/bench_compare.py OLD.json NEW.json [--threshold 0.10]
@@ -138,6 +143,57 @@ def solver_health_deltas(old: dict, new: dict
     return warnings, lines
 
 
+def quality_deltas(old: dict, new: dict) -> Tuple[List[str], List[str]]:
+    """(warnings, report_lines) over the embedded ``quality`` snapshots
+    (bench.py's compact assimilation-quality view).
+
+    Diffed INFORMATIONALLY like ``solver_health`` — consistency is a
+    property of the data and the filter configuration, not a timing
+    gate — with the same class of loud exception: a benchmark whose
+    overall verdict FLIPS away from CONSISTENT (or whose drift
+    sentinels started alarming on a previously-quiet run) is a
+    statistical-consistency break, so it surfaces as an explicit
+    warning.  Still exit 0: the verdict stays with the human, but
+    never silence.
+    """
+    q_old = old.get("quality") or {}
+    q_new = new.get("quality") or {}
+    warnings: List[str] = []
+    lines: List[str] = []
+    w_old = q_old.get("windows") or {}
+    w_new = q_new.get("windows") or {}
+    for key in sorted(set(w_old) | set(w_new)):
+        a, b = w_old.get(key, 0), w_new.get(key, 0)
+        if a == b == 0:
+            continue
+        lines.append(f"  windows[{key}]: {a:g} -> {b:g}")
+    for key in ("drift_events", "drift_active"):
+        a, b = q_old.get(key, 0) or 0, q_new.get(key, 0) or 0
+        if a or b:
+            lines.append(f"  {key}: {a:g} -> {b:g}")
+    v_old, v_new = q_old.get("verdict"), q_new.get("verdict")
+    if v_old != v_new and (v_old or v_new):
+        lines.append(f"  verdict: {v_old} -> {v_new}")
+    if v_old == "CONSISTENT" and v_new not in (None, "CONSISTENT"):
+        warnings.append(
+            f"assimilation-quality verdict flipped CONSISTENT -> "
+            f"{v_new}: the new artifact's filter is statistically "
+            "inconsistent (innovation chi^2 outside the consistency "
+            "band) on a previously-consistent benchmark — inspect "
+            "quality.jsonl (tools/quality_report.py) before trusting "
+            "its timings"
+        )
+    old_drift = float(q_old.get("drift_events") or 0)
+    new_drift = float(q_new.get("drift_events") or 0)
+    if new_drift > 0 and old_drift == 0:
+        warnings.append(
+            f"quality drift_events went 0 -> {new_drift:g}: the drift "
+            "sentinels started alarming on a previously-quiet "
+            "benchmark (sensor/R/Q drift class, not a perf question)"
+        )
+    return warnings, lines
+
+
 def live_telemetry_deltas(old: dict, new: dict) -> List[str]:
     """Informational diff of the embedded ``live_telemetry`` mid-run
     scrape series (tools/loadgen): per shared series, the peak and the
@@ -234,6 +290,13 @@ def main(argv=None) -> int:
         for line in health_lines:
             print(line)
     for w in health_warnings:
+        print(f"bench_compare: WARNING {w}", file=sys.stderr)
+    quality_warnings, quality_lines = quality_deltas(old, new)
+    if quality_lines:
+        print("assimilation-quality deltas (consistency, not gated):")
+        for line in quality_lines:
+            print(line)
+    for w in quality_warnings:
         print(f"bench_compare: WARNING {w}", file=sys.stderr)
     unhealthy = [
         name for name, art in (("old", old), ("new", new))

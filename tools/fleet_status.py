@@ -14,9 +14,14 @@ latency histograms merged into fleet p50/p99, crash-dump pointers, and
 process its own named pid track, timestamps aligned on the shared
 wall-clock epoch) — open it at https://ui.perfetto.dev.
 
+``--watch N`` turns the one-shot view into a live dashboard: clear the
+screen and re-render every N seconds until Ctrl-C (clean exit 0);
+``--watch-count M`` stops after M redraws (the smoke-test hook).
+
 Usage:
     python -m tools.fleet_status /path/to/telemetry [--json]
         [--ttl-s 6] [--queue-dir DIR] [--stitch-trace OUT] [--run-id ID]
+        [--watch N [--watch-count M]]
 
 Exit codes: 0 (view rendered, dead hosts included — liveness is a
 report, not an error), 2 usage/missing root.  Strictly read-only apart
@@ -42,14 +47,24 @@ def render(fleet: dict) -> str:
         state = "DEAD" if w["dead"] else \
             ("exited" if w["final"] else "live")
         extra = ""
+        q = w.get("quality") or {}
+        if q.get("last_verdict"):
+            extra += f"  quality={q['last_verdict']}" + \
+                ("(DRIFT)" if q.get("drift_active") else "")
         if w["crash_dumps"]:
-            extra = f"  crash={w['crash_dumps'][-1]}"
+            extra += f"  crash={w['crash_dumps'][-1]}"
         lines.append(
             f"  {w['key']} [{w['role']}] {state}  "
             f"heartbeat {w['age_s']:.1f}s ago{extra}"
         )
     if fleet["dead_hosts"]:
         lines.append(f"dead hosts: {', '.join(fleet['dead_hosts'])}")
+    fq = fleet.get("quality") or {}
+    if fq.get("drifting_workers"):
+        lines.append(
+            "quality drift ACTIVE on: "
+            + ", ".join(fq["drifting_workers"])
+        )
     queue = fleet.get("queue")
     if queue:
         c = queue["counts"]
@@ -131,11 +146,44 @@ def main(argv=None) -> int:
     ap.add_argument("--run-id", default=None,
                     help="only stitch trace fragments carrying this "
                          "run id")
+    ap.add_argument("--watch", type=float, default=None,
+                    metavar="SECONDS",
+                    help="live dashboard mode: clear the screen and "
+                         "re-render every SECONDS until Ctrl-C")
+    ap.add_argument("--watch-count", type=int, default=0,
+                    help="with --watch: stop after this many redraws "
+                         "(0 = until Ctrl-C; the smoke-test hook)")
     args = ap.parse_args(argv)
     if not os.path.isdir(args.root):
         print(f"fleet_status: no such directory: {args.root}",
               file=sys.stderr)
         return 2
+    if args.watch is None:
+        return _render_once(args)
+    # Live dashboard: fixed-cadence redraw, Ctrl-C = clean exit.  The
+    # ANSI clear keeps it dependency-free (no curses).
+    import time
+
+    n = 0
+    try:
+        while True:
+            print("\x1b[2J\x1b[H", end="")
+            _render_once(args)
+            n += 1
+            if args.watch_count and n >= args.watch_count:
+                return 0
+            # kafkalint: disable=ad-hoc-retry — fixed-cadence dashboard
+            # redraw, not a retry/backoff loop
+            time.sleep(max(0.0, args.watch))
+    except (KeyboardInterrupt, BrokenPipeError):
+        # Ctrl-C, or the consumer of a piped dashboard went away —
+        # both are clean ends of a watch session.
+        return 0
+
+
+def _render_once(args) -> int:
+    """One view build + render (the body of the non-watch mode and of
+    each watch iteration)."""
     fleet = build_view(args.root, ttl_s=args.ttl_s,
                        queue_dir=args.queue_dir)
     if args.stitch_trace:
