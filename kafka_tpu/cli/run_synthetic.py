@@ -155,8 +155,12 @@ def main(argv=None):
                          "aborting")
     ap.add_argument("--http-port", type=int, default=0,
                     help="live metrics endpoint port (/metrics /healthz "
-                         "/statusz; 0 = disabled; fleet mode gives "
-                         "worker i port+i)")
+                         "/statusz /profilez; 0 = disabled; fleet mode "
+                         "gives worker i port+i)")
+    ap.add_argument("--profile-windows", type=int, default=0,
+                    help="capture a jax.profiler trace of the first N "
+                         "assimilated windows into <telemetry-dir>/"
+                         "profile (0 = off; one capture at a time)")
     add_telemetry_arg(ap)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
@@ -172,7 +176,7 @@ def main(argv=None):
         # the one shared filesystem queue.
         return _run_fleet(args, raw_argv)
     from ..telemetry import (
-        configure, flight_recorder, get_registry, live,
+        configure, flight_recorder, get_registry, live, perf,
         install_compile_listeners, tracing,
     )
     from ..telemetry.httpd import maybe_start
@@ -182,6 +186,14 @@ def main(argv=None):
         configure(args.telemetry_dir)
     recorder = flight_recorder.install(args.telemetry_dir)
     httpd = maybe_start(args.http_port, role="engine")
+    if args.profile_windows > 0:
+        # Windowed profiler capture (telemetry.perf): starts now, stops
+        # itself after N assimilated windows; the finally below is the
+        # safety net for runs shorter than N.
+        perf.start_windowed_capture(
+            args.profile_windows,
+            os.path.join(args.telemetry_dir or args.outdir, "profile"),
+        )
     from ..resilience import RetryPolicy, faults
 
     # Chaos hook: KAFKA_TPU_FAULTS scripts deterministic failures at the
@@ -238,6 +250,7 @@ def main(argv=None):
                     sigma, obs_dates, time_grid, read_policy,
                 )
         finally:
+            perf.stop_windowed_capture()
             live.stop_publisher()
             if httpd is not None:
                 httpd.close()

@@ -43,6 +43,7 @@ from ..resilience import (
 from ..telemetry import (
     fetch_scalars,
     get_registry,
+    perf,
     quality,
     record_memory_watermark,
     span,
@@ -590,6 +591,19 @@ class KalmanFilter:
         )
         rec["quality_verdict"] = entry["verdict"]
         rec["quality_drift"] = entry["drift"]["active"]
+        # Performance attribution (telemetry.perf): the live throughput/
+        # device-fraction/roofline gauges, fed from this SAME host-side
+        # record — the packed read above is still the window's only
+        # device->host transfer.
+        perf.record_window(
+            rec,
+            n_valid=self.gather.n_valid,
+            n_pad=self.gather.n_pad,
+            n_params=self.n_params,
+            n_bands=len(rec["chi2_per_band"]),
+            solver_options=self.solver_options,
+            registry=reg,
+        )
         reg.emit(
             "solve",
             **{k: (str(v) if k == "date" else v) for k, v in rec.items()},

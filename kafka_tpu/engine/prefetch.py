@@ -24,7 +24,6 @@ from __future__ import annotations
 import datetime
 import logging
 import threading
-import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .protocols import DateObservation, ObservationSource
@@ -37,7 +36,7 @@ from ..resilience import (
     classify_failure,
     faults,
 )
-from ..telemetry import get_registry, tracing
+from ..telemetry import get_registry, stopwatch, tracing
 
 LOG = logging.getLogger(__name__)
 
@@ -147,7 +146,7 @@ class ObservationPrefetcher:
                     return
                 self._next_claim += 1
             date = self._dates[idx]
-            t0 = time.perf_counter()
+            sw = stopwatch()
 
             def read():
                 faults.fault_point("prefetch.read_date", date=str(date))
@@ -170,11 +169,11 @@ class ObservationPrefetcher:
                 else:
                     item = ("error", exc)
             if item[0] == "ok":
-                t1 = time.perf_counter()
-                self._m_read.observe(t1 - t0)
+                t1 = sw.now()
+                self._m_read.observe(t1 - sw.t0)
                 self._m_reads.inc()
                 self._trace.add_span(
-                    "prefetch_read", t0, t1, cat="io", date=str(date),
+                    "prefetch_read", sw.t0, t1, cat="io", date=str(date),
                 )
             with self._cond:
                 self._results[idx] = item
@@ -192,7 +191,7 @@ class ObservationPrefetcher:
                 return
 
     def get(self, date: datetime.datetime) -> DateObservation:
-        t0 = time.perf_counter()
+        sw = stopwatch()
         with self._cond:
             idx = self._next_emit
             while idx not in self._results and not self._stopped.is_set():
@@ -218,7 +217,7 @@ class ObservationPrefetcher:
             self._trace.add_counter(
                 "prefetch_queue_depth", len(self._results)
             )
-        self._m_wait.observe(time.perf_counter() - t0)
+        self._m_wait.observe(sw.elapsed())
         self._slots.release()
         if kind == "error":
             raise payload

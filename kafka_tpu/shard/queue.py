@@ -70,7 +70,7 @@ from ..resilience import (
     classify_failure,
     faults,
 )
-from ..telemetry import get_registry, tracing
+from ..telemetry import get_registry, stopwatch, tracing
 from ..telemetry import live as live_telemetry
 from .scheduler import (
     _write_marker,
@@ -654,7 +654,7 @@ def _run_claimed(chunk, prefix, run_one, outdir, owner, stats, metrics,
     failure re-runs the whole chunk under the retry policy, which is
     exactly the at-least-once double-execution path the chaos tests pin
     (second completion overwrites with identical bytes)."""
-    t_chunk = time.perf_counter()
+    sw_chunk = stopwatch()
 
     def attempt():
         deadline = Deadline(chunk_deadline_s) if chunk_deadline_s else None
@@ -666,7 +666,7 @@ def _run_claimed(chunk, prefix, run_one, outdir, owner, stats, metrics,
         faults.fault_point("scheduler.commit", prefix=prefix)
         mark_done(outdir, prefix, {
             "chunk": chunk.chunk_no, "worker": owner,
-            "wall_s": round(time.perf_counter() - t_chunk, 3),
+            "wall_s": round(sw_chunk.elapsed(), 3),
         })
 
     try:
@@ -696,10 +696,10 @@ def _run_claimed(chunk, prefix, run_one, outdir, owner, stats, metrics,
             prefix, cls, exc, failed_marker_path(outdir, prefix),
         )
         return False
-    t_end = time.perf_counter()
-    wall = t_end - t_chunk
+    t_end = sw_chunk.now()
+    wall = t_end - sw_chunk.t0
     reg.trace.add_span(
-        "chunk", t_chunk, t_end, lane="scheduler", cat="chunk",
+        "chunk", sw_chunk.t0, t_end, lane="scheduler", cat="chunk",
         prefix=prefix, chunk=chunk.chunk_no,
     )
     stats["run"] += 1

@@ -66,7 +66,7 @@ from ..resilience import (
     classify_failure,
     faults,
 )
-from ..telemetry import get_registry, tracing
+from ..telemetry import get_registry, stopwatch, tracing
 
 LOG = logging.getLogger(__name__)
 
@@ -270,7 +270,7 @@ def run_chunks(
     walls: List[float] = []
     t0 = time.time()
     for a in todo:
-        t_chunk = time.perf_counter()
+        sw_chunk = stopwatch()
 
         def attempt(a=a):
             deadline = Deadline(chunk_deadline_s) \
@@ -316,12 +316,12 @@ def run_chunks(
                 a.prefix, cls, exc, failed_marker_path(outdir, a.prefix),
             )
             continue
-        t_end = time.perf_counter()
-        wall = t_end - t_chunk
+        t_end = sw_chunk.now()
+        wall = t_end - sw_chunk.t0
         # The chunk-level block lands on its own "scheduler" track, so
         # the timeline shows chunk boundaries above the engine phases.
         reg.trace.add_span(
-            "chunk", t_chunk, t_end, lane="scheduler", cat="chunk",
+            "chunk", sw_chunk.t0, t_end, lane="scheduler", cat="chunk",
             prefix=a.prefix, chunk=a.chunk.chunk_no,
         )
         mark_done(outdir, a.prefix, {"chunk": a.chunk.chunk_no,

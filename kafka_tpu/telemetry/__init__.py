@@ -34,14 +34,20 @@ scheduler, the output writers, the CLI drivers and ``bench.py``:
 - :mod:`quality` — assimilation-quality observability: the per-window
   innovation-consistency ledger (``quality.jsonl``), filter-consistency
   verdicts, EWMA/CUSUM drift sentinels, and the ``obs.bias`` chaos
-  site (BASELINE.md "Assimilation quality").
+  site (BASELINE.md "Assimilation quality");
+- :mod:`perf` — performance observability: always-on per-window
+  throughput/device-fraction/phase attribution, the live roofline
+  utilization gauge (analytic traffic bounds shared with
+  ``tools/roofline.py``), and on-demand ``jax.profiler`` capture
+  (``/profilez``, ``--profile-windows``; BASELINE.md "Performance
+  observability").
 
 See BASELINE.md "Observability" for metric names, label conventions, the
 event schema, and "Tracing & crash forensics" for the trace/crash
 artifacts.
 """
 
-from . import flight_recorder, live, quality, tracing
+from . import flight_recorder, live, perf, quality, tracing
 from .compilemon import install_compile_listeners
 from .device import fetch_scalars, record_memory_watermark
 from .registry import (
@@ -51,7 +57,7 @@ from .registry import (
     set_registry,
     use,
 )
-from .spans import span
+from .spans import span, stopwatch
 
 __all__ = [
     "MetricsRegistry",
@@ -61,10 +67,12 @@ __all__ = [
     "get_registry",
     "install_compile_listeners",
     "live",
+    "perf",
     "quality",
     "record_memory_watermark",
     "set_registry",
     "span",
+    "stopwatch",
     "tracing",
     "use",
 ]

@@ -229,6 +229,9 @@ def aggregate_fleet(snapshots: List[dict], now: Optional[float] = None,
             # Per-host assimilation-quality summary (telemetry.quality;
             # absent on pre-quality snapshots).
             "quality": snap.get("quality"),
+            # Per-host performance attribution (telemetry.perf; absent
+            # on pre-perf snapshots).
+            "perf": snap.get("perf"),
             "crash_dumps": list(snap.get("crash_dumps") or ()),
             "status": snap.get("status") or {},
             "path": snap.get("_rel") or snap.get("_path"),

@@ -18,8 +18,16 @@ loopback by default:
 ``/statusz``
     one JSON page of process state: pid/host/uptime, TraceContext run
     id, session/queue facts from the status provider, solver-health
-    counters, and the crash-dump index (which forensics file to read
-    when something already died).
+    counters, perf attribution (throughput / device fraction / roofline
+    utilization — ``telemetry.perf``), and the crash-dump index (which
+    forensics file to read when something already died).
+``/profilez?seconds=N``
+    on-demand ``jax.profiler`` capture (``telemetry.perf.capture``):
+    records N seconds (default 2, capped) of the LIVE run into
+    ``<telemetry dir>/profile/`` and answers with the capture summary.
+    One capture at a time (409 while busy); 503 with a reason when the
+    profiler cannot run here (no telemetry dir, profiler unavailable) —
+    never a crash of the run being observed.
 
 **Port 0 = disabled** at the CLI layer (:func:`maybe_start`): the
 endpoint is opt-in, a batch run should not open sockets.  The class
@@ -41,7 +49,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
-from . import quality, tracing
+from . import perf, quality, tracing
 from .live import build_snapshot, crash_dump_index
 from .registry import MetricsRegistry, get_registry
 
@@ -127,9 +135,12 @@ class TelemetryHTTPd:
                 self._healthz(req, reg, parse_qs(parsed.query))
             elif path == "/statusz":
                 self._statusz(req, reg)
+            elif path == "/profilez":
+                self._profilez(req, reg, parse_qs(parsed.query))
             elif path == "/":
                 self._send_json(req, 200, {
-                    "endpoints": ["/metrics", "/healthz", "/statusz"],
+                    "endpoints": ["/metrics", "/healthz", "/statusz",
+                                  "/profilez"],
                 })
             else:
                 self._send_json(req, 404, {"error": f"no such endpoint "
@@ -177,6 +188,37 @@ class TelemetryHTTPd:
                 ctx = pub._ctx
         return ctx
 
+    def _profilez(self, req, reg, query: Dict[str, list]) -> None:
+        """On-demand profiler capture into the telemetry dir.  Blocks
+        THIS handler thread for the capture length (the server is
+        threaded, other endpoints keep answering)."""
+        try:
+            seconds = float(query.get("seconds", ["2"])[0])
+        except ValueError:
+            self._send_json(req, 400, {
+                "error": "seconds must be a number",
+            })
+            return
+        if not reg.directory:
+            self._send_json(req, 503, {
+                "error": "no telemetry directory configured — start the "
+                         "run with --telemetry-dir to give captures a "
+                         "home",
+            })
+            return
+        directory = os.path.join(
+            reg.directory, "profile", time.strftime("%Y%m%dT%H%M%S")
+        )
+        try:
+            result = perf.capture(seconds, directory, registry=reg)
+        except perf.CaptureBusy as exc:
+            self._send_json(req, 409, {"error": str(exc)})
+            return
+        except perf.CaptureUnavailable as exc:
+            self._send_json(req, 503, {"error": str(exc)})
+            return
+        self._send_json(req, 200, {"ok": True, **result})
+
     def _statusz(self, req, reg) -> None:
         ctx = self._run_context()
         solver = {
@@ -201,6 +243,9 @@ class TelemetryHTTPd:
             # Assimilation-quality verdicts (telemetry.quality): the
             # science-side health next to the process-side one.
             "quality": quality.summary(reg),
+            # Performance attribution (telemetry.perf): live throughput,
+            # device fraction, phase breakdown, roofline utilization.
+            "perf": perf.summary(reg),
             "crash_dumps": crash_dump_index(reg.directory),
             "status": status,
         })

@@ -39,7 +39,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from . import quality, tracing
+from . import perf, quality, tracing
 from .registry import MetricsRegistry, _label_text, get_registry
 
 #: snapshot schema version (bumped on breaking changes; consumers skip
@@ -147,6 +147,9 @@ def build_snapshot(registry: Optional[MetricsRegistry] = None,
         # Assimilation-quality verdicts (telemetry.quality): the fleet
         # view folds these into per-host quality columns.
         "quality": quality.summary(reg),
+        # Performance attribution (telemetry.perf): throughput / device
+        # fraction / roofline utilization, per host in the fleet view.
+        "perf": perf.summary(reg),
         "counters": counters,
         "gauges": gauges,
         "histograms": histograms,

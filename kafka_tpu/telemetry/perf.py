@@ -1,0 +1,453 @@
+"""Performance observability: always-on attribution, live roofline
+utilization, and on-demand profiler capture.
+
+The fleet plane (PR 10) watches processes and the quality ledger (PR 11)
+watches the science; this module watches SPEED.  Until now performance
+existed only as post-hoc BENCH artifacts compared pairwise — the e2e row
+swung 35.7k/72.8k/44.0k px-steps/s across rounds 3-5 with no code change
+(bench.py docstring), and the ROADMAP's mesh/ingest acceptance bars
+(``e2e_device_fraction >= 0.9``, ``device_mesh_px_s``) could not be
+observed on a live run at all.  Three layers close that:
+
+- **Steady-state attribution** (:func:`record_window`): the engine calls
+  this once per assimilated window, from the SAME host-side record the
+  one packed ``fetch_scalars`` read already built — zero added device
+  transfers, ``kafka_engine_device_reads_total == dispatches`` holds
+  with attribution active (tier-1-asserted).  Publishes live gauges:
+  ``kafka_perf_px_steps_per_s`` (rolling per-window throughput),
+  ``kafka_perf_device_fraction`` (rolling device share of wall time,
+  the live form of bench.py's ``e2e_device_fraction``), and
+  ``kafka_perf_phase_fraction{phase=}`` (busy fractions derived from
+  the PR 2/3 span histograms: fetch/advance/solve/dump/write — phases
+  on concurrent threads are per-phase busy fractions and may sum past
+  1.0 when the pipeline overlaps well; that overlap IS the signal).
+- **Live roofline utilization** (:func:`roofline_utilization`): the
+  analytic minimum-traffic bounds from ``tools/roofline.py`` live here
+  now (the tool imports them back), so every window's device time folds
+  into ``kafka_perf_roofline_utilization{component=}`` — the fraction of
+  the HBM roof the solve is provably sustaining (a LOWER bound, same
+  derivation as the tool; see PAPER.md's 3.80 ms vs ~0.32 ms bound).  A
+  degraded run shows up as a utilization drop on a dashboard instead of
+  three PRs later in a bench diff.  Only meaningful on a real TPU; the
+  gauge still publishes off-TPU (tiny values) so the plumbing is
+  testable on CPU.
+- **On-demand profiler capture** (:func:`capture` /
+  :func:`start_windowed_capture`): programmatic ``jax.profiler`` capture
+  into the telemetry dir, serving the ``/profilez?seconds=N`` httpd
+  endpoint and the drivers' ``--profile-windows N`` flag.  One capture
+  at a time (concurrent requests get :class:`CaptureBusy`); where the
+  profiler is unavailable the caller gets :class:`CaptureUnavailable`
+  and the endpoint answers a clean 503.  Captured traces join
+  compilemon's compile spans and the span annotations in one timeline.
+
+See BASELINE.md "Performance observability" for the gauge table and the
+capture recipe.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+import weakref
+from typing import Deque, Dict, Optional, Tuple
+
+from .registry import MetricsRegistry, get_registry
+
+# ---------------------------------------------------------------------------
+# Device roofs and analytic minimum-traffic bounds.
+#
+# Single home for the numbers ``tools/roofline.py`` derives utilisation
+# from (the tool imports these back): v5e public roofs
+# (jax-ml.github.io/scaling-book: 16 GB HBM at 819 GB/s, 197 TFLOP/s
+# bf16) and the fusion-perfect byte counts — every live input read once,
+# every output written once.  Utilisation derived from these is a true
+# LOWER bound on achieved bandwidth; the XLA cost model's per-fusion
+# byte accounting is NOT used (it produced impossible >100%-of-roof
+# numbers in earlier rounds — see the tool's docstring).
+# ---------------------------------------------------------------------------
+
+HBM_GBPS = 819.0
+PEAK_TFLOPS_BF16 = 197.0
+
+_F32 = 4  # bytes; the device paths are float32 throughout (kafkalint
+#           implicit-f64 enforces it)
+
+
+def min_traffic_linearize(n_pix: int, p: int, n_bands: int) -> int:
+    """Batched value+Jacobian: reads x ``(n, p)``, writes h0 ``(B, n)``
+    + jac ``(B, n, p)``."""
+    return n_pix * _F32 * (p + n_bands * (1 + p))
+
+
+def min_traffic_update(n_pix: int, p: int, n_bands: int) -> int:
+    """One packed normal-equations update: linearisation + observations
+    + states in, solution + packed A out."""
+    return n_pix * _F32 * (
+        n_bands * (1 + p)          # h0 + jac
+        + 3 * n_bands              # y, r_inv, mask (bool rounded up)
+        + 2 * p                    # x_lin, x_f
+        + p * p                    # p_inv_f (dense as stored)
+        + p                        # x out
+        + p * p                    # A out
+    )
+
+
+def min_traffic_gn_full(n_pix: int, p: int, n_bands: int) -> int:
+    """The WHOLE per-date Gauss-Newton solve, fusion-perfect: inputs
+    once, outputs once — iterations live in VMEM/registers in the ideal
+    kernel (the bound both ``gn_full`` and ``gn_full_pallas`` are
+    measured against in ``tools/roofline.py``)."""
+    return n_pix * _F32 * (
+        3 * n_bands + 2 * p + p * p   # obs + x_f(+x_lin=x_f) + p_inv_f
+        + p + p * p                   # x out + A out
+    )
+
+
+def min_traffic_gn_inkernel(n_pix: int, p: int, n_bands: int) -> int:
+    """The in-kernel-linearise generation's re-derived bound: packed
+    prior/information triangles instead of dense ``(p, p)`` batches, and
+    the diagnostic outputs (fwd, innovations, per-block counters) the
+    kernel actually emits are COUNTED (``gn_full``'s bound
+    conservatively omits them)."""
+    tri = p * (p + 1) // 2
+    return n_pix * _F32 * (
+        3 * n_bands        # y, r_inv, mask in
+        + p                # x_f lane rows in
+        + tri              # P_f^-1 packed rows in
+        + p + tri          # x out + packed A out
+        + 2 * n_bands      # fwd + innovation diagnostics out
+        + 2                # per-block iteration/norm rows out
+    )
+
+
+#: solve-generation component -> its analytic bound (the runtime gauge's
+#: label values; ``tools/roofline.py`` components carry the same names).
+TRAFFIC_BOUNDS = {
+    "gn_full": min_traffic_gn_full,
+    "gn_full_pallas": min_traffic_gn_full,
+    "gn_inkernel": min_traffic_gn_inkernel,
+}
+
+
+def component_for(solver_options: Optional[dict]) -> str:
+    """Which solve generation a window ran, from the engine's solver
+    options — the ``component=`` label of the utilization gauge."""
+    so = solver_options or {}
+    if so.get("use_pallas"):
+        if so.get("inkernel_linearize", False):
+            return "gn_inkernel"
+        return "gn_full_pallas"
+    return "gn_full"
+
+
+def roofline_utilization(component: str, n_pix: int, p: int,
+                         n_bands: int, device_s: float,
+                         ) -> Optional[float]:
+    """Fraction of the HBM roof the window's solve provably sustained:
+    ``min_traffic / (device_s * roof)``.  None when untimeable."""
+    bound = TRAFFIC_BOUNDS.get(component, min_traffic_gn_full)
+    if device_s <= 0:
+        return None
+    return bound(n_pix, p, n_bands) / (device_s * HBM_GBPS * 1e9)
+
+
+# ---------------------------------------------------------------------------
+# Always-on steady-state attribution.
+#
+# Per-registry rolling state: a deque of (ts, cumulative px-steps,
+# cumulative device seconds) samples, one per recorded window.  The
+# rolling rate over the deque span smooths per-window jitter without
+# hiding a sustained slowdown; a fused block's k records share one
+# arrival timestamp, which the cumulative form handles for free.
+# ---------------------------------------------------------------------------
+
+#: windows in the rolling attribution window.
+ROLL_WINDOW = 32
+
+#: phase -> (histogram metric, label kv) whose cumulative sum feeds the
+#: phase-fraction gauge (the PR 2/3 span histograms; ``solve`` comes
+#: from the attribution state's own device-seconds accumulator).
+PHASE_SOURCES: Dict[str, Tuple[str, Dict[str, str]]] = {
+    "fetch": ("kafka_prefetch_read_seconds", {}),
+    "advance": ("kafka_engine_phase_seconds", {"phase": "advance"}),
+    "dump": ("kafka_engine_phase_seconds", {"phase": "dump"}),
+    "write": ("kafka_io_write_seconds", {}),
+}
+
+
+class _PerfState:
+    """Rolling attribution state for one registry."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        # (arrival perf_counter ts, cumulative px-steps, cumulative
+        # device seconds) — maxlen+1 so a full deque still spans
+        # ROLL_WINDOW inter-sample intervals.
+        self.samples: Deque[Tuple[float, float, float]] = \
+            collections.deque(maxlen=ROLL_WINDOW + 1)
+        self.t_origin: Optional[float] = None
+        self.px_total = 0.0
+        self.device_total = 0.0
+
+
+_states: "weakref.WeakKeyDictionary[MetricsRegistry, _PerfState]" = \
+    weakref.WeakKeyDictionary()
+_states_lock = threading.Lock()
+
+
+def _state_for(reg: MetricsRegistry) -> _PerfState:
+    with _states_lock:
+        st = _states.get(reg)
+        if st is None:
+            st = _states[reg] = _PerfState()
+        return st
+
+
+def _hist_sum(reg: MetricsRegistry, name: str,
+              labels: Dict[str, str]) -> float:
+    val = reg.value(name, **labels)
+    if isinstance(val, dict):
+        return float(val.get("sum") or 0.0)
+    return 0.0
+
+
+def record_window(rec: dict, *, n_valid: int, n_pad: int, n_params: int,
+                  n_bands: int, solver_options: Optional[dict] = None,
+                  registry: Optional[MetricsRegistry] = None) -> None:
+    """Fold one assimilated window into the live perf gauges.
+
+    Called by the engine from ``_record_window`` with the record the
+    packed diagnostic read already produced — attribution adds ZERO
+    device->host transfers.  ``rec["wall_s"]`` is the device-inclusive
+    dispatch wall the diagnostics log has always carried (a fused
+    block's records each carry ``wall/k``), which is exactly the
+    quantity bench.py's ``e2e_device_fraction`` sums — the live gauge
+    and the bench row are the same arithmetic.
+    """
+    reg = registry if registry is not None else get_registry()
+    st = _state_for(reg)
+    now = time.perf_counter()
+    device_s = float(rec.get("wall_s") or 0.0)
+    px_steps = float(n_valid)
+    with st.lock:
+        if st.t_origin is None:
+            # The first record's dispatch covered the whole first block:
+            # anchor the wall-time origin at its start so the very first
+            # device fraction is 1.0, not a division by ~zero.
+            st.t_origin = now - max(
+                device_s * float(rec.get("fused", 1)), 1e-9
+            )
+        st.px_total += px_steps
+        st.device_total += device_s
+        st.samples.append((now, st.px_total, st.device_total))
+        t_old, px_old, dev_old = st.samples[0]
+        dt = now - t_old
+        if dt < 1e-6:
+            # Rolling window collapsed to one instant (a fused block's
+            # records arrive together): fall back to run-cumulative.
+            t_old, px_old, dev_old = st.t_origin, 0.0, 0.0
+            dt = max(now - st.t_origin, 1e-9)
+        px_rate = (st.px_total - px_old) / dt
+        dev_frac = min(1.0, (st.device_total - dev_old) / dt)
+        elapsed = max(now - st.t_origin, 1e-9)
+        device_total = st.device_total
+
+    reg.gauge(
+        "kafka_perf_px_steps_per_s",
+        "rolling assimilation throughput (valid pixels x window steps "
+        "per wall second) over the last windows — the live form of the "
+        "bench e2e row",
+    ).set(px_rate)
+    reg.gauge(
+        "kafka_perf_device_fraction",
+        "rolling fraction of wall time spent in device-inclusive solve "
+        "dispatch — the live form of bench e2e_device_fraction",
+    ).set(dev_frac)
+
+    # Phase busy fractions: cumulative span-histogram seconds over
+    # cumulative run wall.  Overlapped phases (prefetch threads, the
+    # async writer) legitimately make these sum past 1.0.
+    phase_gauge = reg.gauge(
+        "kafka_perf_phase_fraction",
+        "per-phase busy fraction of run wall time (fetch/advance/solve/"
+        "dump/write, from the span histograms; overlapped phases may "
+        "sum past 1)",
+    )
+    for phase, (metric, labels) in PHASE_SOURCES.items():
+        phase_gauge.set(_hist_sum(reg, metric, labels) / elapsed,
+                        phase=phase)
+    phase_gauge.set(device_total / elapsed, phase="solve")
+
+    component = component_for(solver_options)
+    util = roofline_utilization(
+        component, n_pad, n_params, n_bands, device_s
+    )
+    if util is not None:
+        reg.gauge(
+            "kafka_perf_roofline_utilization",
+            "fraction of the HBM roof the latest window's solve "
+            "provably sustained (analytic minimum traffic / measured "
+            "device time; lower bound — only meaningful on TPU)",
+        ).set(util, component=component)
+
+    _tick_windowed_capture(reg)
+
+
+def summary(registry: Optional[MetricsRegistry] = None) -> dict:
+    """Compact perf state for ``/statusz``, live snapshots and the BENCH
+    artifact: the throughput/device-fraction gauges, the per-component
+    roofline utilization, and the phase breakdown."""
+    reg = registry if registry is not None else get_registry()
+    roofline: Dict[str, float] = {}
+    phases: Dict[str, float] = {}
+    for m in reg.metrics():
+        if m.name == "kafka_perf_roofline_utilization":
+            for key, val in m._series():
+                roofline[dict(key).get("component", "?")] = val
+        elif m.name == "kafka_perf_phase_fraction":
+            for key, val in m._series():
+                phases[dict(key).get("phase", "?")] = round(val, 6)
+    return {
+        "px_steps_per_s": reg.value("kafka_perf_px_steps_per_s"),
+        "device_fraction": reg.value("kafka_perf_device_fraction"),
+        "roofline_utilization": roofline,
+        "phases": phases,
+    }
+
+
+# ---------------------------------------------------------------------------
+# On-demand profiler capture (jax.profiler programmatic API).
+# ---------------------------------------------------------------------------
+
+class CaptureUnavailable(RuntimeError):
+    """``jax.profiler`` missing or refusing to start — the caller (the
+    httpd endpoint) degrades to a clean 503, never a crash."""
+
+
+class CaptureBusy(RuntimeError):
+    """A capture is already running; one at a time by design (two
+    concurrent profiler sessions corrupt each other's dumps)."""
+
+
+#: maximum /profilez capture length — a handler thread is held for the
+#: duration, so the knob is bounded.
+MAX_CAPTURE_S = 60.0
+
+_capture_lock = threading.Lock()
+_windowed = {"remaining": 0, "directory": None}
+_windowed_lock = threading.Lock()
+
+
+def _start_trace(directory: str) -> None:
+    try:
+        import jax.profiler
+    except Exception as exc:  # noqa: BLE001 — any import failure = no profiler
+        raise CaptureUnavailable(f"jax.profiler unavailable: {exc!r}")
+    os.makedirs(directory, exist_ok=True)
+    try:
+        jax.profiler.start_trace(directory)
+    except Exception as exc:  # noqa: BLE001 — backend-specific refusals all mean "cannot capture here"
+        raise CaptureUnavailable(f"profiler refused to start: {exc!r}")
+
+
+def _stop_trace() -> None:
+    try:
+        import jax.profiler
+
+        jax.profiler.stop_trace()
+    except Exception:  # a failed stop must not kill the run being observed
+        pass
+
+
+def capture(seconds: float, directory: str,
+            registry: Optional[MetricsRegistry] = None) -> dict:
+    """Run one bounded profiler capture into ``directory`` and block
+    until it finishes.  Raises :class:`CaptureBusy` when another capture
+    (including a windowed one) is active, :class:`CaptureUnavailable`
+    when the profiler cannot run here.  Returns a summary dict the
+    ``/profilez`` endpoint answers with."""
+    seconds = max(0.05, min(float(seconds), MAX_CAPTURE_S))
+    reg = registry if registry is not None else get_registry()
+    if not _capture_lock.acquire(blocking=False):
+        raise CaptureBusy("a profiler capture is already running")
+    t0 = time.perf_counter()
+    try:
+        _start_trace(directory)
+        # Bounded wait, not time.sleep: the run under observation keeps
+        # going on its own threads while this handler thread idles.
+        threading.Event().wait(seconds)
+        _stop_trace()
+    finally:
+        _capture_lock.release()
+    files = sum(len(fs) for _, _, fs in os.walk(directory))
+    _captures_total(reg).inc()
+    reg.emit(
+        "profile_capture", directory=directory, seconds=seconds,
+        files=files, wall_s=round(time.perf_counter() - t0, 3),
+    )
+    return {"directory": directory, "seconds": seconds, "files": files}
+
+
+def _captures_total(reg: MetricsRegistry):
+    """Single registration site (metric-name lint: one owner per name)."""
+    return reg.counter(
+        "kafka_perf_profile_captures_total",
+        "completed on-demand jax.profiler captures (/profilez or "
+        "--profile-windows)",
+    )
+
+
+def start_windowed_capture(n_windows: int, directory: str,
+                           registry: Optional[MetricsRegistry] = None,
+                           ) -> None:
+    """Drivers' ``--profile-windows N``: start a capture now and stop it
+    automatically after the next ``n_windows`` assimilated windows (the
+    attribution path ticks it).  ``stop_windowed_capture`` is the
+    end-of-run safety net for short runs."""
+    if n_windows <= 0:
+        return
+    if not _capture_lock.acquire(blocking=False):
+        raise CaptureBusy("a profiler capture is already running")
+    try:
+        _start_trace(directory)
+    except CaptureUnavailable:
+        _capture_lock.release()
+        raise
+    with _windowed_lock:
+        _windowed["remaining"] = int(n_windows)
+        _windowed["directory"] = directory
+    reg = registry if registry is not None else get_registry()
+    reg.emit("profile_windows_started", directory=directory,
+             windows=int(n_windows))
+
+
+def _tick_windowed_capture(reg: MetricsRegistry) -> None:
+    with _windowed_lock:
+        if not _windowed["directory"]:
+            return
+        _windowed["remaining"] -= 1
+        if _windowed["remaining"] > 0:
+            return
+    stop_windowed_capture(registry=reg)
+
+
+def stop_windowed_capture(registry: Optional[MetricsRegistry] = None,
+                          ) -> Optional[dict]:
+    """Stop an active windowed capture (idempotent; returns the capture
+    summary, or None when no windowed capture was running)."""
+    with _windowed_lock:
+        directory = _windowed["directory"]
+        if not directory:
+            return None
+        _windowed["directory"] = None
+        _windowed["remaining"] = 0
+    _stop_trace()
+    _capture_lock.release()
+    reg = registry if registry is not None else get_registry()
+    files = sum(len(fs) for _, _, fs in os.walk(directory))
+    _captures_total(reg).inc()
+    reg.emit("profile_capture", directory=directory, files=files,
+             windowed=True)
+    return {"directory": directory, "files": files}

@@ -21,6 +21,40 @@ from . import tracing
 from .registry import MetricsRegistry, get_registry
 
 
+class Stopwatch:
+    """The sanctioned raw timer for device-adjacent host code.
+
+    kafkalint rule 15 (``ad-hoc-timing``) bans bare
+    ``time.perf_counter``/``time.monotonic`` timing in ``core/``,
+    ``engine/``, ``shard/`` and ``obsops/`` so every measured interval
+    flows through the telemetry layer — either a :func:`span` (which
+    also lands in the histograms and the trace timeline) or, where the
+    caller needs the raw readings (metric observations with labels,
+    ``TraceBuffer.add_span`` endpoints), this stopwatch.  ``t0`` and
+    :meth:`now` are ``time.perf_counter`` readings, directly usable as
+    trace-span endpoints.
+    """
+
+    __slots__ = ("t0",)
+
+    def __init__(self):
+        self.t0 = time.perf_counter()
+
+    @staticmethod
+    def now() -> float:
+        """Current ``perf_counter`` reading (a span endpoint)."""
+        return time.perf_counter()
+
+    def elapsed(self) -> float:
+        """Seconds since construction."""
+        return time.perf_counter() - self.t0
+
+
+def stopwatch() -> Stopwatch:
+    """Start a :class:`Stopwatch` (the device-adjacent timing funnel)."""
+    return Stopwatch()
+
+
 @contextlib.contextmanager
 def span(phase: str, registry: Optional[MetricsRegistry] = None,
          **fields) -> Iterator[None]:

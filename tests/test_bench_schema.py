@@ -24,13 +24,14 @@ EXPECTED_KEYS = [
     "device_pallas_ms", "device_pallas_ms_spread", "device_pallas_px_s",
     "device_pallas_fused_lin_ms", "device_pallas_fused_lin_ms_spread",
     "device_pallas_fused_lin_px_s",
-    "e2e_pixel_steps_per_s", "e2e_device_fraction", "e2e_n_pixels",
+    "e2e_pixel_steps_per_s", "e2e_pixel_steps_per_s_spread",
+    "e2e_device_fraction", "e2e_n_pixels",
     "serve_p50_ms", "serve_p99_ms", "serve_cold_ms",
     "serve_rejected_total", "serve_requests_total",
     "live_telemetry",
     "probe_device_ms", "probe_host_ms", "probe_retried",
     "unhealthy_reasons", "probe_host_after_ms", "unhealthy",
-    "telemetry", "solver_health", "quality",
+    "telemetry", "solver_health", "quality", "perf",
 ]
 
 HEALTH_KEYS = {
@@ -62,7 +63,7 @@ def _assemble(reg, host_after_ms=0.3, serve=SERVE_ROWS):
         device=(8.2e7, 6.4, 0.05),
         pallas=None,           # off-TPU: the Pallas rows are never measured
         fused_lin=None,
-        e2e=(5.0e4, 0.55, 7212),
+        e2e=(5.0e4, 0.55, 7212, 1.2e4),
         serve=serve,
         host_after_ms=host_after_ms,
         registry=reg,

@@ -1,0 +1,618 @@
+"""Performance observability (ISSUE 12): always-on attribution gauges,
+live roofline utilization, on-demand profiler capture, and the
+bench-history trend ledger.
+
+Acceptance: a CPU ``run_synthetic`` run publishes live
+``kafka_perf_px_steps_per_s``, device-fraction and roofline-utilization
+gauges visible via ``/metrics`` and ``fleet_status``, with
+``kafka_engine_device_reads_total == dispatches`` still asserted;
+``tools/bench_history.py`` over the checked-in BENCH_r01-r05 renders a
+per-row trend table that flags the e2e rows unjudgeable by spread.
+"""
+
+import datetime
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from kafka_tpu import telemetry  # noqa: E402
+from kafka_tpu.telemetry import MetricsRegistry, perf  # noqa: E402
+
+from tools import bench_history  # noqa: E402
+
+
+def day(i):
+    return datetime.datetime(2021, 3, 1) + datetime.timedelta(days=i)
+
+
+def run_identity_engine(telemetry_dir=None, scan_window=1,
+                        prefetch_depth=2):
+    """Small identity-operator run: 8 observation dates, 5 grid windows.
+    Returns ``(kf, out, reg)`` — the shared engine harness shape of
+    tests/test_quality.py."""
+    import jax.numpy as jnp
+
+    from kafka_tpu.core.propagators import (
+        PixelPrior, propagate_information_filter_approx,
+    )
+    from kafka_tpu.engine import FixedGaussianPrior, KalmanFilter
+    from kafka_tpu.obsops.identity import IdentityOperator
+    from kafka_tpu.testing.fixtures import make_pivot_mask
+    from kafka_tpu.testing.synthetic import (
+        MemoryOutput, SyntheticObservations,
+    )
+
+    mask = make_pivot_mask(20, 20, seed=0)
+    p = 2
+    op = IdentityOperator(n_params=p, obs_indices=(0, 1))
+    cov = np.diag(np.full(p, 0.4 ** 2)).astype(np.float32)
+    prior = FixedGaussianPrior(
+        PixelPrior(
+            mean=jnp.full((p,), 0.5, jnp.float32),
+            cov=jnp.asarray(cov),
+            inv_cov=jnp.asarray(np.linalg.inv(cov)),
+        ),
+        ("a", "b"),
+    )
+    truth = np.broadcast_to(
+        np.array([0.3, 0.7], np.float32), mask.shape + (2,)
+    ).astype(np.float32)
+    with telemetry.use(MetricsRegistry(telemetry_dir)) as reg:
+        obs = SyntheticObservations(
+            dates=[day(i) for i in range(1, 16, 2)], operator=op,
+            truth_fn=lambda d: truth, sigma=0.02, mask_prob=0.1, seed=0,
+        )
+        out = MemoryOutput()
+        kf = KalmanFilter(
+            obs, out, mask, ("a", "b"),
+            state_propagation=propagate_information_filter_approx,
+            prior=None, solver_options={"relaxation": 0.5},
+            scan_window=scan_window, prefetch_depth=prefetch_depth,
+        )
+        kf.set_trajectory_model()
+        kf.set_trajectory_uncertainty(np.full(p, 1e-3, np.float32))
+        x0, p_inv0 = prior.process_prior(None, kf.gather)
+        kf.run([day(i) for i in range(0, 20, 4)], x0, None, p_inv0)
+    return kf, out, reg
+
+
+# ---------------------------------------------------------------------------
+# Analytic traffic bounds: one derivation, shared by the runtime gauge
+# and tools/roofline.py.
+# ---------------------------------------------------------------------------
+
+class TestTrafficBounds:
+    def test_bounds_positive_and_linear_in_pixels(self):
+        for fn in (perf.min_traffic_linearize, perf.min_traffic_update,
+                   perf.min_traffic_gn_full,
+                   perf.min_traffic_gn_inkernel):
+            a = fn(1000, 7, 2)
+            b = fn(2000, 7, 2)
+            assert a > 0 and b == 2 * a
+
+    def test_roofline_tool_imports_the_same_bounds(self):
+        """tools/roofline.py must derive its table from THESE formulas —
+        a drifted copy would make the live gauge and the tool disagree
+        about the same kernel."""
+        from tools import roofline
+
+        assert roofline.min_traffic_gn_full is perf.min_traffic_gn_full
+        assert roofline.min_traffic_gn_inkernel is \
+            perf.min_traffic_gn_inkernel
+        assert roofline.HBM_GBPS == perf.HBM_GBPS
+
+    def test_component_mapping_follows_solver_options(self):
+        assert perf.component_for(None) == "gn_full"
+        assert perf.component_for({}) == "gn_full"
+        assert perf.component_for({"use_pallas": True}) == \
+            "gn_full_pallas"
+        assert perf.component_for(
+            {"use_pallas": True, "inkernel_linearize": True}
+        ) == "gn_inkernel"
+
+    def test_utilization_is_bound_over_traffic_time(self):
+        u = perf.roofline_utilization("gn_full", 1 << 19, 7, 2, 0.0038)
+        expected = perf.min_traffic_gn_full(1 << 19, 7, 2) / (
+            0.0038 * perf.HBM_GBPS * 1e9
+        )
+        assert u == pytest.approx(expected)
+        assert perf.roofline_utilization("gn_full", 10, 7, 2, 0.0) is None
+
+
+# ---------------------------------------------------------------------------
+# Always-on attribution through the real engine.
+# ---------------------------------------------------------------------------
+
+class TestAttribution:
+    @pytest.mark.parametrize("scan_window", [1, 4])
+    def test_engine_publishes_perf_gauges(self, scan_window):
+        kf, _, reg = run_identity_engine(scan_window=scan_window)
+        assert kf.diagnostics_log, "no windows assimilated"
+        rate = reg.value("kafka_perf_px_steps_per_s")
+        frac = reg.value("kafka_perf_device_fraction")
+        assert rate is not None and rate > 0
+        # The acceptance band: device fraction in (0, 1], computed from
+        # the same wall_s sums bench.py's e2e row uses.
+        assert frac is not None and 0 < frac <= 1.0
+        util = reg.value(
+            "kafka_perf_roofline_utilization", component="gn_full"
+        )
+        assert util is not None and util > 0
+        solve_frac = reg.value(
+            "kafka_perf_phase_fraction", phase="solve"
+        )
+        assert solve_frac is not None and 0 < solve_frac <= 1.0
+        for phase in ("fetch", "advance", "dump", "write"):
+            assert reg.value(
+                "kafka_perf_phase_fraction", phase=phase
+            ) is not None
+
+    def test_device_reads_invariant_with_attribution_active(self):
+        """THE invariant, re-asserted with perf sampling on: attribution
+        derives from the record the one packed read built — reads ==
+        dispatches, fused and unfused."""
+        for scan_window in (1, 4):
+            kf, _, reg = run_identity_engine(scan_window=scan_window)
+            expected = sum(
+                1.0 / rec.get("fused", 1) for rec in kf.diagnostics_log
+            )
+            assert expected == int(expected)
+            assert reg.value("kafka_engine_device_reads_total") == \
+                int(expected)
+            # ... and the gauges were indeed published on this run.
+            assert reg.value("kafka_perf_px_steps_per_s") > 0
+
+    def test_device_fraction_consistent_with_bench_e2e_arithmetic(self):
+        """The live gauge is the same quantity bench_end_to_end derives:
+        sum of the diagnostics log's wall_s over elapsed wall — the
+        cumulative gauge must not exceed that sum's share by more than
+        rolling-window effects allow (it is a fraction of REAL time, so
+        never above 1)."""
+        kf, _, reg = run_identity_engine()
+        device_s = sum(r["wall_s"] for r in kf.diagnostics_log)
+        assert device_s > 0
+        assert 0 < reg.value("kafka_perf_device_fraction") <= 1.0
+
+    def test_summary_shape(self):
+        _, _, reg = run_identity_engine()
+        s = perf.summary(reg)
+        assert set(s) == {
+            "px_steps_per_s", "device_fraction",
+            "roofline_utilization", "phases",
+        }
+        assert "gn_full" in s["roofline_utilization"]
+        assert "solve" in s["phases"]
+        empty = perf.summary(MetricsRegistry())
+        assert empty["px_steps_per_s"] is None
+        assert empty["roofline_utilization"] == {}
+
+
+# ---------------------------------------------------------------------------
+# Profiler capture: programmatic, one at a time, off-TPU safe.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def stub_profiler(monkeypatch, tmp_path):
+    """Replace the jax.profiler seam with a marker-file stub: capture
+    MECHANICS (locking, windowed ticks, endpoint plumbing) test
+    deterministically — a real stop_trace grows slow late in a long
+    jax session and real captures are covered once, directly."""
+    def fake_start(directory):
+        os.makedirs(directory, exist_ok=True)
+        open(os.path.join(directory, "capture.marker"), "w").close()
+
+    monkeypatch.setattr(perf, "_start_trace", fake_start)
+    monkeypatch.setattr(perf, "_stop_trace", lambda: None)
+    return tmp_path
+
+
+class TestProfilerCapture:
+    def test_real_capture_writes_or_degrades_cleanly(self, tmp_path):
+        """The ONE real-profiler test: the programmatic capture either
+        materialises a dump directory or raises the clean
+        CaptureUnavailable — never a crash (the off-TPU acceptance)."""
+        reg = MetricsRegistry()
+        d = str(tmp_path / "profile")
+        try:
+            result = perf.capture(0.1, d, registry=reg)
+        except perf.CaptureUnavailable:
+            assert not perf._capture_lock.locked()
+            return  # profiler genuinely absent here — the clean path
+        assert result["directory"] == d
+        assert os.path.isdir(d)
+        assert reg.value("kafka_perf_profile_captures_total") == 1
+        # The lock was released: nothing holds the one-capture slot.
+        assert not perf._capture_lock.locked()
+
+    def test_one_capture_at_a_time(self, stub_profiler):
+        tmp_path = stub_profiler
+        reg = MetricsRegistry()
+        perf.start_windowed_capture(5, str(tmp_path / "w"), registry=reg)
+        try:
+            with pytest.raises(perf.CaptureBusy):
+                perf.capture(0.05, str(tmp_path / "p"), registry=reg)
+        finally:
+            assert perf.stop_windowed_capture(registry=reg) is not None
+        # Idempotent stop; lock released.
+        assert perf.stop_windowed_capture(registry=reg) is None
+        perf.capture(0.05, str(tmp_path / "p2"), registry=reg)
+        assert not perf._capture_lock.locked()
+
+    def test_windowed_capture_stops_after_n_windows(self, stub_profiler):
+        tmp_path = stub_profiler
+        reg = MetricsRegistry()
+        rec = {"wall_s": 0.001, "chi2_per_band": [1.0]}
+        perf.start_windowed_capture(2, str(tmp_path / "w"), registry=reg)
+        try:
+            for _ in range(2):
+                perf.record_window(
+                    rec, n_valid=10, n_pad=16, n_params=2, n_bands=1,
+                    registry=reg,
+                )
+            # The second window ticked the capture closed.
+            assert perf._windowed["directory"] is None
+            assert reg.value(
+                "kafka_perf_profile_captures_total"
+            ) == 1
+        finally:
+            perf.stop_windowed_capture(registry=reg)
+
+    def test_unavailable_profiler_releases_the_slot(self, monkeypatch,
+                                                    tmp_path):
+        def refuse(directory):
+            raise perf.CaptureUnavailable("no profiler here")
+
+        monkeypatch.setattr(perf, "_start_trace", refuse)
+        with pytest.raises(perf.CaptureUnavailable):
+            perf.capture(0.05, str(tmp_path / "p"))
+        with pytest.raises(perf.CaptureUnavailable):
+            perf.start_windowed_capture(2, str(tmp_path / "w"))
+        assert not perf._capture_lock.locked()
+
+
+class TestProfilezEndpoint:
+    def _get(self, url):
+        try:
+            with urllib.request.urlopen(url, timeout=30) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as exc:
+            return exc.code, exc.read().decode()
+
+    def test_profilez_smoke_capture_file_appears(self, stub_profiler):
+        """ISSUE 12 acceptance, 200 branch: the endpoint runs a capture
+        into <telemetry dir>/profile/ and the capture file appears."""
+        from kafka_tpu.telemetry.httpd import TelemetryHTTPd
+
+        tmp_path = stub_profiler
+        reg = MetricsRegistry(str(tmp_path))
+        httpd = TelemetryHTTPd(port=0, registry=reg).start()
+        try:
+            code, body = self._get(
+                httpd.url + "/profilez?seconds=0.1"
+            )
+            payload = json.loads(body)
+            assert code == 200, body
+            assert payload["ok"] is True
+            assert payload["directory"].startswith(
+                os.path.join(str(tmp_path), "profile")
+            )
+            assert os.path.exists(
+                os.path.join(payload["directory"], "capture.marker")
+            )
+            assert reg.value(
+                "kafka_perf_profile_captures_total"
+            ) == 1
+        finally:
+            httpd.close()
+            reg.close()
+
+    def test_profilez_unavailable_profiler_is_clean_503(
+            self, monkeypatch, tmp_path):
+        """ISSUE 12 acceptance, 503 branch: where the profiler cannot
+        run (off-TPU stripped builds), the endpoint answers a clean 503
+        — the run being observed never crashes."""
+        from kafka_tpu.telemetry.httpd import TelemetryHTTPd
+
+        def refuse(directory):
+            raise perf.CaptureUnavailable("no profiler here")
+
+        monkeypatch.setattr(perf, "_start_trace", refuse)
+        reg = MetricsRegistry(str(tmp_path))
+        httpd = TelemetryHTTPd(port=0, registry=reg).start()
+        try:
+            code, body = self._get(httpd.url + "/profilez?seconds=0.1")
+            assert code == 503
+            assert "profiler" in json.loads(body)["error"]
+        finally:
+            httpd.close()
+            reg.close()
+
+    def test_profilez_busy_is_409(self, stub_profiler):
+        from kafka_tpu.telemetry.httpd import TelemetryHTTPd
+
+        tmp_path = stub_profiler
+        reg = MetricsRegistry(str(tmp_path))
+        perf.start_windowed_capture(5, str(tmp_path / "w"), registry=reg)
+        httpd = TelemetryHTTPd(port=0, registry=reg).start()
+        try:
+            code, body = self._get(httpd.url + "/profilez?seconds=0.1")
+            assert code == 409
+            assert "already running" in json.loads(body)["error"]
+        finally:
+            httpd.close()
+            perf.stop_windowed_capture(registry=reg)
+            reg.close()
+
+    def test_profilez_without_telemetry_dir_is_503(self):
+        from kafka_tpu.telemetry.httpd import TelemetryHTTPd
+
+        reg = MetricsRegistry()  # no directory
+        httpd = TelemetryHTTPd(port=0, registry=reg).start()
+        try:
+            code, body = self._get(httpd.url + "/profilez")
+            assert code == 503
+            assert "telemetry" in json.loads(body)["error"]
+        finally:
+            httpd.close()
+
+    def test_statusz_and_index_carry_perf(self, tmp_path):
+        from kafka_tpu.telemetry.httpd import TelemetryHTTPd
+
+        with telemetry.use(MetricsRegistry()) as reg:
+            rec = {"wall_s": 0.002, "chi2_per_band": [1.0]}
+            perf.record_window(
+                rec, n_valid=10, n_pad=16, n_params=2, n_bands=1,
+                registry=reg,
+            )
+            httpd = TelemetryHTTPd(port=0, registry=reg).start()
+            try:
+                code, body = self._get(httpd.url + "/statusz")
+                assert code == 200
+                status = json.loads(body)
+                assert status["perf"]["px_steps_per_s"] > 0
+                code, body = self._get(httpd.url + "/")
+                assert "/profilez" in json.loads(body)["endpoints"]
+            finally:
+                httpd.close()
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: the CPU driver run publishes live perf gauges end to end.
+# ---------------------------------------------------------------------------
+
+class TestRunSyntheticLive:
+    def test_driver_publishes_perf_plane(self, tmp_path):
+        from kafka_tpu.telemetry import get_registry, set_registry
+        from kafka_tpu.cli.run_synthetic import main
+        from tools.fleet_status import build_view
+
+        tel = str(tmp_path / "tel")
+        prev = get_registry()
+        try:
+            summary = main([
+                "--operator", "identity", "--ny", "40", "--nx", "40",
+                "--outdir", str(tmp_path / "out"),
+                "--telemetry-dir", tel,
+            ])
+            reg = get_registry()
+            rate = reg.value("kafka_perf_px_steps_per_s")
+            frac = reg.value("kafka_perf_device_fraction")
+            assert rate is not None and rate > 0
+            assert frac is not None and 0 < frac <= 1.0
+            assert reg.value(
+                "kafka_perf_roofline_utilization", component="gn_full"
+            ) > 0
+            # /metrics surface: the exposition the endpoint serves and
+            # metrics.prom archives carries the gauges.
+            prom = open(os.path.join(tel, "metrics.prom")).read()
+            assert "kafka_perf_px_steps_per_s" in prom
+            assert "kafka_perf_device_fraction" in prom
+            assert 'kafka_perf_roofline_utilization{' \
+                'component="gn_full"}' in prom
+            # The packed-read funnel was the diagnostic path (the exact
+            # reads == dispatches equality is pinned in-engine by
+            # TestAttribution; fusion makes dispatches < n_dates here).
+            reads = reg.value("kafka_engine_device_reads_total")
+            assert reads is not None and 0 < reads <= summary["n_dates"]
+        finally:
+            set_registry(prev)
+        # Fleet surface: the live snapshot carried the perf summary and
+        # fleet_status renders it per worker.
+        snaps = [
+            f for f in os.listdir(tel)
+            if f.startswith("live_") and f.endswith(".json")
+        ]
+        assert snaps
+        snap = json.load(open(os.path.join(tel, snaps[0])))
+        assert snap["perf"]["px_steps_per_s"] > 0
+        assert 0 < snap["perf"]["device_fraction"] <= 1.0
+        view = build_view(tel)
+        workers = [w for w in view["workers"] if w.get("perf")]
+        assert workers
+        assert workers[0]["perf"]["px_steps_per_s"] > 0
+        from tools.fleet_status import render
+
+        assert "perf=" in render(view)
+
+    def test_profile_windows_flag(self, stub_profiler):
+        """--profile-windows N: the driver starts a windowed capture
+        into <telemetry-dir>/profile and the attribution path closes it
+        after N windows (profiler seam stubbed — the flag's plumbing is
+        under test, the real capture path has its own test)."""
+        from kafka_tpu.telemetry import get_registry, set_registry
+        from kafka_tpu.cli.run_synthetic import main
+
+        tmp_path = stub_profiler
+        tel = str(tmp_path / "tel")
+        prev = get_registry()
+        try:
+            main([
+                "--operator", "identity", "--ny", "24", "--nx", "24",
+                "--days", "8", "--outdir", str(tmp_path / "out"),
+                "--telemetry-dir", tel,
+                "--profile-windows", "2",
+            ])
+            reg = get_registry()
+            assert reg.value(
+                "kafka_perf_profile_captures_total"
+            ) == 1
+        finally:
+            set_registry(prev)
+            perf.stop_windowed_capture()
+        assert os.path.exists(
+            os.path.join(tel, "profile", "capture.marker")
+        )
+
+
+# ---------------------------------------------------------------------------
+# bench_history: the multi-artifact trend ledger.
+# ---------------------------------------------------------------------------
+
+def _write(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+class TestBenchHistory:
+    def test_unwrap_artifact(self):
+        bare = {"metric": "x", "device_xla_ms": 6.4}
+        assert bench_history.unwrap_artifact(bare) is bare
+        wrapped = {"n": 3, "cmd": "python bench.py", "rc": 0,
+                   "tail": "...", "parsed": bare}
+        assert bench_history.unwrap_artifact(wrapped) == bare
+        assert bench_history.unwrap_artifact(
+            {"n": 1, "cmd": "x", "rc": 0, "tail": "", "parsed": None}
+        ) == {}
+        assert bench_history.unwrap_artifact([1, 2]) == {}
+
+    def test_noisy_row_is_unjudgeable_and_trends_survive(self, tmp_path):
+        paths = [
+            _write(tmp_path, f"r{i}.json", doc) for i, doc in enumerate([
+                {"e2e_pixel_steps_per_s": 74000.0,
+                 "device_xla_ms": 7.1, "device_pallas_px_s": 1.0e8},
+                {"e2e_pixel_steps_per_s": 36000.0,
+                 "device_xla_ms": 6.6, "device_pallas_px_s": 9.0e7},
+                {"e2e_pixel_steps_per_s": 73000.0,
+                 "device_xla_ms": 6.5, "device_pallas_px_s": 7.0e7},
+                {"e2e_pixel_steps_per_s": 44000.0,
+                 "device_xla_ms": 5.2, "device_pallas_px_s": 6.0e7},
+            ])
+        ]
+        hist = bench_history.build_history(paths)
+        rows = hist["rows"]
+        e2e = rows["e2e_pixel_steps_per_s"]
+        assert e2e["verdict"] == "unjudgeable"
+        assert "both directions" in e2e["reason"]
+        # A monotone ms drop is improving (direction-aware) ...
+        assert rows["device_xla_ms"]["verdict"] == "improving"
+        # ... and a monotone px/s drop is regressing.
+        assert rows["device_pallas_px_s"]["verdict"] == "regressing"
+
+    def test_recorded_spread_flags_unjudgeable(self, tmp_path):
+        paths = [
+            _write(tmp_path, f"r{i}.json", {
+                "oracle_ms_median": v, "oracle_ms_median_spread": s,
+            })
+            for i, (v, s) in enumerate([(700.0, 900.0), (660.0, 1900.0)])
+        ]
+        rows = bench_history.build_history(paths)["rows"]
+        assert rows["oracle_ms_median"]["verdict"] == "unjudgeable"
+        assert "spread" in rows["oracle_ms_median"]["reason"]
+
+    def test_single_point_and_flat(self, tmp_path):
+        paths = [
+            _write(tmp_path, "a.json", {"serve_p99_ms": 20.0}),
+        ]
+        rows = bench_history.build_history(paths)["rows"]
+        assert rows["serve_p99_ms"]["verdict"] == "single"
+        paths.append(_write(tmp_path, "b.json", {"serve_p99_ms": 20.5}))
+        rows = bench_history.build_history(paths)["rows"]
+        assert rows["serve_p99_ms"]["verdict"] == "flat"
+
+    def test_wrapped_and_bare_mix(self, tmp_path):
+        paths = [
+            _write(tmp_path, "w.json", {
+                "n": 1, "cmd": "c", "rc": 0, "tail": "",
+                "parsed": {"device_xla_ms": 6.4},
+            }),
+            _write(tmp_path, "b.json", {"device_xla_ms": 6.5}),
+        ]
+        hist = bench_history.build_history(paths)
+        assert hist["n_artifacts"] == 2
+        assert hist["rows"]["device_xla_ms"]["n"] == 2
+
+    def test_cli_json_and_exit_codes(self, tmp_path, capsys):
+        p = _write(tmp_path, "one.json", {"device_xla_ms": 6.4})
+        assert bench_history.main([p, "--json"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["n_artifacts"] == 1
+        assert payload["rows"]["device_xla_ms"]["verdict"] == "single"
+        missing = str(tmp_path / "gone.json")
+        assert bench_history.main([missing]) == 2
+
+    def test_nulls_are_absent_rounds_not_zeros(self, tmp_path):
+        paths = [
+            _write(tmp_path, "a.json",
+                   {"device_pallas_ms": None, "device_xla_ms": 6.4}),
+            _write(tmp_path, "b.json",
+                   {"device_pallas_ms": 3.8, "device_xla_ms": 6.5}),
+        ]
+        rows = bench_history.build_history(paths)["rows"]
+        assert rows["device_pallas_ms"]["verdict"] == "single"
+        assert rows["device_pallas_ms"]["rounds"] == [1]
+
+
+class TestBenchHistoryCheckedInArtifacts:
+    """CI satellite: the repo's own bench trajectory is a regression-
+    tested artifact — bench_history must parse all five archived rounds
+    (wrapper format) and render a trend, flagging the e2e row
+    unjudgeable by spread."""
+
+    PATHS = [
+        os.path.join(REPO_ROOT, f"BENCH_r0{i}.json") for i in range(1, 6)
+    ]
+
+    def test_all_five_rounds_parse_and_render(self, capsys):
+        assert bench_history.main(self.PATHS) == 0
+        out = capsys.readouterr().out
+        assert "5 artifact(s)" in out
+        for i in range(1, 6):
+            assert f"BENCH_r0{i}.json" in out
+
+    def test_e2e_row_flagged_unjudgeable(self):
+        hist = bench_history.build_history(self.PATHS)
+        assert hist["n_artifacts"] == 5
+        # Every archived round is wrapper format and yields real rows
+        # (r01 predates most rows but carries the headline value).
+        assert all(m["rows"] >= 1 for m in hist["artifacts"])
+        e2e = hist["rows"]["e2e_pixel_steps_per_s"]
+        assert e2e["verdict"] == "unjudgeable"
+        assert e2e["n"] == 4  # r02-r05 carry the row
+        # The headline throughput row is NOT drowned by its r01->r02
+        # improvement staircase: one-directional moves stay judgeable.
+        assert hist["rows"]["value"]["verdict"] in (
+            "flat", "improving"
+        )
+
+    def test_bench_compare_reads_wrapped_artifacts(self, capsys):
+        """Satellite: bench_compare unwraps the archive format — two
+        checked-in rounds compare on their real content instead of
+        finding the wrapper row-less."""
+        from tools import bench_compare
+
+        rc = bench_compare.main([self.PATHS[3], self.PATHS[4]])
+        out = capsys.readouterr().out
+        assert rc == 0
+        # The unwrapped artifacts' rows were seen (both rounds predate
+        # the gated device_*_ms rows, so the report says so explicitly
+        # rather than comparing wrapper keys).
+        assert "BENCH_r04.json -> " in out or "r04" in out

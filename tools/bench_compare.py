@@ -249,10 +249,39 @@ def telemetry_deltas(old: dict, new: dict, top: int = 8) -> List[str]:
     return out
 
 
+def _unwrap_artifact(doc):
+    """``tools.bench_history.unwrap_artifact`` (the one owner of the
+    archive-wrapper format), resolved across every way this file gets
+    loaded: package module, ``python tools/bench_compare.py`` script,
+    or a bare file-path import."""
+    try:
+        from .bench_history import unwrap_artifact
+    except ImportError:
+        try:  # script mode: tools/ itself is sys.path[0]
+            from bench_history import unwrap_artifact
+        except ImportError:  # file-path import: resolve the sibling file
+            import importlib.util
+            import os
+
+            spec = importlib.util.spec_from_file_location(
+                "_bench_history_sibling",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "bench_history.py"),
+            )
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            unwrap_artifact = mod.unwrap_artifact
+    return unwrap_artifact(doc)
+
+
 def load(path: str) -> Optional[dict]:
+    """One artifact, unwrapping the harness archive wrapper format
+    ``{"n","cmd","rc","tail","parsed"}`` the checked-in BENCH_r0*.json
+    use (tools/bench_history.py owns the unwrap) — so comparing two
+    archived rounds works directly instead of silently finding no rows."""
     try:
         with open(path) as f:
-            return json.load(f)
+            return _unwrap_artifact(json.load(f))
     except (OSError, json.JSONDecodeError) as exc:
         print(f"bench_compare: cannot load {path}: {exc}",
               file=sys.stderr)

@@ -51,6 +51,11 @@ def render(fleet: dict) -> str:
         if q.get("last_verdict"):
             extra += f"  quality={q['last_verdict']}" + \
                 ("(DRIFT)" if q.get("drift_active") else "")
+        p = w.get("perf") or {}
+        if p.get("px_steps_per_s"):
+            extra += f"  perf={p['px_steps_per_s']:.3g}px/s"
+            if p.get("device_fraction") is not None:
+                extra += f",df={p['device_fraction']:.2f}"
         if w["crash_dumps"]:
             extra += f"  crash={w['crash_dumps'][-1]}"
         lines.append(

@@ -44,15 +44,34 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
-# v5e public roofs (jax-ml.github.io/scaling-book: v5e 16 GB HBM at
-# 819 GB/s; 197 TFLOP/s bf16).
-HBM_GBPS = 819.0
-PEAK_TFLOPS_BF16 = 197.0
+# Script-mode bootstrap: `python tools/roofline.py` puts tools/ (not the
+# repo root) on sys.path, so the kafka_tpu import below needs the root
+# added explicitly; `python -m tools.roofline` and test imports already
+# have it and skip this.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+# Roofs and analytic minimum-traffic bounds live in the TELEMETRY layer
+# now (kafka_tpu.telemetry.perf) so the runtime publishes the same
+# utilisation lower bound as a live gauge
+# (kafka_perf_roofline_utilization{component=}) that this tool prints as
+# a table — one derivation, two consumers.  Re-exported here so existing
+# imports of tools.roofline.HBM_GBPS keep working.
+from kafka_tpu.telemetry.perf import (  # noqa: F401 — re-export
+    HBM_GBPS,
+    PEAK_TFLOPS_BF16,
+    min_traffic_gn_full,
+    min_traffic_gn_inkernel,
+    min_traffic_linearize,
+    min_traffic_update,
+)
 
 
 def slope_time(fn, flush, k1=5, k2=25, reps=5, target_s=1.5):
@@ -165,14 +184,13 @@ def tip_components(n_pix, rows):
             jnp.asarray(op.state_bounds[0]), jnp.asarray(op.state_bounds[1])
         )
     }
-    f32 = 4
 
     # -- linearize: reads x (n,p), writes h0 (B,n) + jac (B,n,p).
     lin_jit = jax.jit(lambda x: op.linearize(None, x))
-    min_lin = n_pix * f32 * (p + n_bands * (1 + p))
     measure(
         f"tip/linearize", lin_jit, (x0,),
-        lambda o: np.asarray(o.h0[0, :1]), rows, min_lin,
+        lambda o: np.asarray(o.h0[0, :1]), rows,
+        min_traffic_linearize(n_pix, p, n_bands),
         note=f"value+jacfwd, p={p}, {n_bands} bands",
     )
 
@@ -181,17 +199,10 @@ def tip_components(n_pix, rows):
     upd_jit = jax.jit(
         lambda l, b, xl, xf, pf: kalman_update(l, b, xl, xf, pf)
     )
-    min_upd = n_pix * f32 * (
-        n_bands * (1 + p)          # h0 + jac
-        + 3 * n_bands              # y, r_inv, mask (mask is bool=1B; round up)
-        + 2 * p                    # x_lin, x_f
-        + p * p                    # p_inv_f (dense as stored)
-        + p                        # x out
-        + p * p                    # A out
-    )
     measure(
         f"tip/update", upd_jit, (lin, bands, x0, x0, p_inv0),
-        lambda o: np.asarray(o[0][:1, 0]), rows, min_upd,
+        lambda o: np.asarray(o[0][:1, 0]), rows,
+        min_traffic_update(n_pix, p, n_bands),
         note="packed assembly + packed Cholesky + substitution",
     )
 
@@ -202,10 +213,7 @@ def tip_components(n_pix, rows):
     n_iters = int(out[2].n_iterations)
     # Fusion-perfect traffic for the WHOLE solve: inputs once, outputs
     # once — iterations live in VMEM/registers in the ideal kernel.
-    min_full = n_pix * f32 * (
-        3 * n_bands + 2 * p + p * p   # obs + x_f(+x_lin=x_f) + p_inv_f
-        + p + p * p                   # x out + A out
-    )
+    min_full = min_traffic_gn_full(n_pix, p, n_bands)
     row = measure(
         f"tip/gn_full", _full_jit(op, opts), (bands, x0, p_inv0),
         lambda o: np.asarray(o[0][:1, 0]), rows, min_full,
@@ -229,23 +237,16 @@ def tip_components(n_pix, rows):
         )
         row_pl["n_iterations"] = n_iters
         # -- the in-kernel-linearise generation: the WHOLE loop as one
-        # launch.  Re-derived analytic bound: with linearisation,
-        # iteration carry and packed A all VMEM-resident, the only HBM
-        # traffic left is the observations in, the forecast in (the
-        # packed prior triangle — the dense (p, p) batch never needs to
-        # cross for the kernel proper), and the solution + diagnostics
-        # out.  Unlike min_full above this bound COUNTS the diagnostic
-        # outputs (fwd, innovations, per-block counters) the solve
-        # emits — gn_full's bound conservatively omitted them.
-        tri = p * (p + 1) // 2
-        min_inkernel = n_pix * f32 * (
-            3 * n_bands        # y, r_inv, mask in
-            + p                # x_f lane rows in
-            + tri              # P_f^-1 packed rows in
-            + p + tri          # x out + packed A out
-            + 2 * n_bands      # fwd + innovation diagnostics out
-            + 2                # per-block iteration/norm rows out
-        )
+        # launch.  Re-derived analytic bound (perf.min_traffic_gn_inkernel):
+        # with linearisation, iteration carry and packed A all
+        # VMEM-resident, the only HBM traffic left is the observations
+        # in, the forecast in (the packed prior triangle — the dense
+        # (p, p) batch never needs to cross for the kernel proper), and
+        # the solution + diagnostics out.  Unlike min_full above this
+        # bound COUNTS the diagnostic outputs (fwd, innovations,
+        # per-block counters) the solve emits — gn_full's bound
+        # conservatively omitted them.
+        min_inkernel = min_traffic_gn_inkernel(n_pix, p, n_bands)
         row_ik = measure(
             "tip/gn_inkernel",
             _full_jit(op, {**opts, "use_pallas": True,
@@ -309,13 +310,12 @@ def prosail_components(n_pix, rows):
     aux = prosail_aux_builder(
         {"sza": 30.0, "saa": 120.0, "vza": 5.0, "vaa": 200.0}, None
     )
-    f32 = 4
 
     lin_jit = jax.jit(lambda x: op.linearize(aux, x))
-    min_lin = n_pix * f32 * (p + n_bands * (1 + p))
     measure(
         "prosail/linearize", lin_jit, (x0,),
-        lambda o: np.asarray(o.h0[0, :1]), rows, min_lin,
+        lambda o: np.asarray(o.h0[0, :1]), rows,
+        min_traffic_linearize(n_pix, p, n_bands),
         note=f"exact-SAIL value+jacfwd, p={p}, {n_bands} bands",
     )
 
@@ -332,12 +332,10 @@ def prosail_components(n_pix, rows):
     upd_jit = jax.jit(
         lambda l, b, xl, xf, pf: kalman_update(l, b, xl, xf, pf)
     )
-    min_upd = n_pix * f32 * (
-        n_bands * (1 + p) + 3 * n_bands + 2 * p + p * p + p + p * p
-    )
     measure(
         "prosail/update", upd_jit, (lin, bands, x0, x0, p_inv0),
-        lambda o: np.asarray(o[0][:1, 0]), rows, min_upd,
+        lambda o: np.asarray(o[0][:1, 0]), rows,
+        min_traffic_update(n_pix, p, n_bands),
         note="packed assembly + packed Cholesky + substitution",
     )
     return rows
