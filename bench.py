@@ -342,6 +342,7 @@ def assemble_result(
     host_after_ms: float,
     fused_lin=None,        # (px_s, ms_median, ms_spread) or None (off-TPU)
     serve=None,            # tools/loadgen rows dict or None
+    fleet=None,            # tools/loadgen bench_fleet rows dict or None
     n_matched: int = 16384,
     n_device: int = 1 << 19,
     registry=None,
@@ -449,6 +450,24 @@ def assemble_result(
         # load, diffed informationally by tools/bench_compare.py.
         "live_telemetry": None if serve is None
         else serve.get("live_telemetry"),
+        # Elastic-fleet serving rows (tools/loadgen.bench_fleet: N
+        # in-process replicas behind the consistent-hash router, one
+        # client-visible serving surface).  serve_fleet_p50/p99_ms gate
+        # in tools/bench_compare.py like the single-daemon rows;
+        # rerouted/backoff are context (a policy outcome, not a
+        # latency).
+        "serve_fleet_p50_ms": None if fleet is None
+        else fleet.get("serve_fleet_p50_ms"),
+        "serve_fleet_p99_ms": None if fleet is None
+        else fleet.get("serve_fleet_p99_ms"),
+        "serve_fleet_replicas": None if fleet is None
+        else fleet.get("serve_fleet_replicas"),
+        "serve_fleet_requests_total": None if fleet is None
+        else fleet.get("serve_fleet_requests_total"),
+        "serve_fleet_rerouted_total": None if fleet is None
+        else fleet.get("serve_fleet_rerouted_total"),
+        "serve_backoff_total": None if fleet is None
+        else fleet.get("serve_backoff_total"),
         # Bench health layer (see telemetry.health.probe_health): off-band
         # probes flag the whole artifact so cross-round consumers discard
         # it instead of reading environment weather as a perf change.
@@ -606,6 +625,7 @@ def _bench_rows():
         )
     e2e = bench_end_to_end()
     serve = bench_serve_rows()
+    fleet = bench_fleet_rows()
     host_after_ms = probe_host()
     print(json.dumps(assemble_result(
         health,
@@ -616,6 +636,7 @@ def _bench_rows():
         fused_lin=fused_lin,
         e2e=e2e,
         serve=serve,
+        fleet=fleet,
         host_after_ms=host_after_ms,
         n_matched=n_matched,
         n_device=n_device,
@@ -647,6 +668,39 @@ def bench_serve_rows(requests: int = 24, concurrency: int = 4):
         return rows
     except Exception as exc:  # degrade to null rows: the serving bench must never cost the solve rows
         print(f"serve bench failed ({exc!r}) — serving rows null",
+              file=sys.stderr)
+        return None
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_fleet_rows(replicas: int = 3, requests: int = 24,
+                     concurrency: int = 4):
+    """The elastic-fleet serving rows via tools/loadgen's in-process
+    N-replica + consistent-hash-router harness — the serve_fleet_*
+    BENCH rows bench_compare gates.  Failure degrades to null rows with
+    a loud stderr note rather than killing the solve rows."""
+    import shutil
+    import tempfile
+
+    from tools.loadgen import bench_fleet
+
+    tmp = tempfile.mkdtemp(prefix="kafka_bench_fleet_")
+    try:
+        rows = bench_fleet(tmp, replicas=replicas, requests=requests,
+                           concurrency=concurrency)
+        print(
+            f"fleet: p50 {rows['serve_fleet_p50_ms']} ms, "
+            f"p99 {rows['serve_fleet_p99_ms']} ms over "
+            f"{rows['serve_fleet_ok_total']} ok / "
+            f"{rows['serve_fleet_requests_total']} requests across "
+            f"{rows['serve_fleet_replicas']} replicas "
+            f"(rerouted {rows['serve_fleet_rerouted_total']})",
+            file=sys.stderr,
+        )
+        return rows
+    except Exception as exc:  # degrade to null rows: the fleet bench must never cost the solve rows
+        print(f"fleet bench failed ({exc!r}) — fleet rows null",
               file=sys.stderr)
         return None
     finally:

@@ -51,6 +51,12 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--tiles", type=int, default=1,
                     help="number of synthetic tiles to serve "
                          "(tile0..tileN-1)")
+    ap.add_argument("--ckpt-root", default=None,
+                    help="directory holding the ckpt_<tile>/ checkpoint "
+                         "sets (default: --root).  Replicas of an "
+                         "elastic fleet SHARE this root so re-routing a "
+                         "tile to another replica resumes it warm — "
+                         "the checkpoint set is the canonical state")
     ap.add_argument("--operator", default="identity",
                     choices=("identity", "twostream", "wcm"))
     ap.add_argument("--ny", type=int, default=20)
@@ -91,6 +97,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "unbounded telemetry)")
     ap.add_argument("--events-keep", type=int, default=3,
                     help="rotated events.jsonl segments kept")
+    ap.add_argument("--journal-rotate-mb", type=float, default=64.0,
+                    help="compact requests.jsonl past this size: "
+                         "answered-and-checkpointed entries rotate into "
+                         "size-capped segments (0 disables; a resident "
+                         "daemon cannot afford an unbounded journal)")
+    ap.add_argument("--journal-keep", type=int, default=3,
+                    help="rotated requests.jsonl segments kept")
     ap.add_argument("--http-port", type=int, default=0,
                     help="live metrics endpoint port (/metrics "
                          "Prometheus text, /healthz, /statusz with "
@@ -147,11 +160,12 @@ def main(argv=None):
 
     faults.install_from_env()
     os.makedirs(args.root, exist_ok=True)
+    ckpt_root = args.ckpt_root or args.root
     sessions = {}
     for i in range(max(1, args.tiles)):
         name = f"tile{i}"
         spec = make_synthetic_tile(
-            name, ckpt_dir=os.path.join(args.root, f"ckpt_{name}"),
+            name, ckpt_dir=os.path.join(ckpt_root, f"ckpt_{name}"),
             operator=args.operator, ny=args.ny, nx=args.nx,
             days=args.days, step_days=args.step,
             obs_every=args.obs_every, scan_window=args.scan_window,
@@ -175,6 +189,11 @@ def main(argv=None):
     service = AssimilationService(
         sessions, args.root, policy=policy,
         default_deadline_s=args.deadline_s,
+        journal_rotate_bytes=(
+            int(args.journal_rotate_mb * 1024 * 1024)
+            if args.journal_rotate_mb > 0 else None
+        ),
+        journal_keep=args.journal_keep,
     )
     daemon = ServeDaemon(
         service, args.root,

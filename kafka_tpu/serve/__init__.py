@@ -11,6 +11,7 @@ from .admission import AdmissionController, AdmissionPolicy
 from .daemon import ServeDaemon, read_response, submit_request
 from .journal import RequestJournal
 from .request import BadRequest, ServeRequest, parse_request
+from .router import HashRing, RoutePolicy, TileRouter, stable_hash
 from .service import AssimilationService
 from .session import TileSession, TileSpec, UnknownDateError
 from .synthetic import make_synthetic_tile, synthetic_dates
@@ -20,15 +21,19 @@ __all__ = [
     "AdmissionPolicy",
     "AssimilationService",
     "BadRequest",
+    "HashRing",
     "RequestJournal",
+    "RoutePolicy",
     "ServeDaemon",
     "ServeRequest",
+    "TileRouter",
     "TileSession",
     "TileSpec",
     "UnknownDateError",
     "make_synthetic_tile",
     "parse_request",
     "read_response",
+    "stable_hash",
     "submit_request",
     "synthetic_dates",
 ]

@@ -78,6 +78,22 @@ class AdmissionPolicy:
     #: by default: most operators want degraded answers SERVED and
     #: labelled (the response's ``quality`` field), not refused.
     shed_on_quality_drift: bool = False
+    #: backoff hint attached to LOAD-STATE rejections (queue_full,
+    #: draining, fleet_degraded, ...): clients that honor it
+    #: (tools/loadgen, the kafka-route front door) wait instead of
+    #: hammering a shedding replica.  Request-shaped rejections
+    #: (bad_request, unknown_tile) never carry it — retrying cannot
+    #: make a bad request good.
+    retry_after_s: float = 0.5
+
+
+#: rejection reasons that describe the SERVER's state, not the
+#: request's — the ones a client should back off and retry (possibly
+#: against another replica).
+RETRYABLE_REASONS = frozenset({
+    "queue_full", "prefetch_backlog", "writer_backlog", "unhealthy",
+    "fleet_degraded", "quality_degraded", "draining",
+})
 
 
 class AdmissionController:
@@ -86,6 +102,14 @@ class AdmissionController:
 
     def __init__(self, policy: Optional[AdmissionPolicy] = None):
         self.policy = policy or AdmissionPolicy()
+
+    def retry_after(self, reason: str) -> Optional[float]:
+        """The backoff hint for one rejection reason — the policy's
+        ``retry_after_s`` for load-state rejections, None for
+        request-shaped ones."""
+        if reason in RETRYABLE_REASONS:
+            return self.policy.retry_after_s
+        return None
 
     def decide(self, queue_depth: int) -> Optional[str]:
         """``None`` to admit, else the rejection reason (a short token

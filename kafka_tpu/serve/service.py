@@ -119,9 +119,13 @@ class AssimilationService:
         default_deadline_s: Optional[float] = None,
         retry_policy: Optional[RetryPolicy] = None,
         result_cache_size: int = 256,
+        journal_rotate_bytes: Optional[int] = None,
+        journal_keep: int = 3,
     ):
         self.sessions = dict(sessions)
-        self.journal = RequestJournal(root)
+        self.journal = RequestJournal(
+            root, rotate_bytes=journal_rotate_bytes, keep=journal_keep,
+        )
         self.admission = AdmissionController(policy)
         self.default_deadline_s = default_deadline_s
         self._retry = retry_policy if retry_policy is not None \
@@ -272,6 +276,11 @@ class AssimilationService:
         )
         ack = {"request_id": request_id, "status": "rejected",
                "reason": reason}
+        # Load-state rejections carry the backoff hint so clients wait
+        # out the overload instead of hammering a shedding replica.
+        retry_after = self.admission.retry_after(reason)
+        if retry_after is not None:
+            ack["retry_after_s"] = retry_after
         if detail:
             ack["detail"] = detail
         if request_id and isinstance(request_id, str):

@@ -29,6 +29,9 @@ EXPECTED_KEYS = [
     "serve_p50_ms", "serve_p99_ms", "serve_cold_ms",
     "serve_rejected_total", "serve_requests_total",
     "live_telemetry",
+    "serve_fleet_p50_ms", "serve_fleet_p99_ms", "serve_fleet_replicas",
+    "serve_fleet_requests_total", "serve_fleet_rerouted_total",
+    "serve_backoff_total",
     "probe_device_ms", "probe_host_ms", "probe_retried",
     "unhealthy_reasons", "probe_host_after_ms", "unhealthy",
     "telemetry", "solver_health", "quality", "perf",
@@ -54,7 +57,20 @@ SERVE_ROWS = {
 }
 
 
-def _assemble(reg, host_after_ms=0.3, serve=SERVE_ROWS):
+#: a tools/loadgen.bench_fleet rows dict, as the elastic-fleet bench
+#: emits it (ISSUE 13).
+FLEET_ROWS = {
+    "serve_fleet_p50_ms": 5.1, "serve_fleet_p99_ms": 30.0,
+    "serve_fleet_requests_total": 24, "serve_fleet_ok_total": 24,
+    "serve_fleet_rejected_total": 0, "serve_fleet_error_total": 0,
+    "serve_fleet_rps": 50.0, "serve_fleet_rerouted_total": 0,
+    "serve_fleet_replicas": 3, "serve_fleet_cold_ms": 900.0,
+    "serve_backoff_total": 0,
+}
+
+
+def _assemble(reg, host_after_ms=0.3, serve=SERVE_ROWS,
+              fleet=FLEET_ROWS):
     health = bench.probe_health(retry_wait_s=0.0, registry=reg)
     return health, bench.assemble_result(
         health,
@@ -65,6 +81,7 @@ def _assemble(reg, host_after_ms=0.3, serve=SERVE_ROWS):
         fused_lin=None,
         e2e=(5.0e4, 0.55, 7212, 1.2e4),
         serve=serve,
+        fleet=fleet,
         host_after_ms=host_after_ms,
         registry=reg,
     )
@@ -212,6 +229,24 @@ class TestBenchArtifactSchema:
         assert result["serve_p99_ms"] is None
         assert result["serve_rejected_total"] is None
         assert result["live_telemetry"] is None
+
+    def test_fleet_rows_flow_through(self):
+        """The elastic-fleet rows (tools/loadgen.bench_fleet) land
+        verbatim; a run without a fleet bench degrades them to null
+        (serve_fleet_p50/p99_ms disappearance then gates in
+        bench_compare like the single-daemon rows)."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            _, result = _assemble(reg)
+        assert result["serve_fleet_p50_ms"] == 5.1
+        assert result["serve_fleet_p99_ms"] == 30.0
+        assert result["serve_fleet_replicas"] == 3
+        assert result["serve_fleet_rerouted_total"] == 0
+        assert result["serve_backoff_total"] == 0
+        with telemetry.use(MetricsRegistry()) as reg:
+            _, result = _assemble(reg, fleet=None)
+        assert result["serve_fleet_p50_ms"] is None
+        assert result["serve_fleet_p99_ms"] is None
+        assert result["serve_fleet_rerouted_total"] is None
 
     def test_live_telemetry_flows_through(self):
         """The mid-run /metrics scrape series (tools/loadgen) lands

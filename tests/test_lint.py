@@ -36,7 +36,7 @@ ALL_RULES = {
     "event-collision", "kernel-relayout", "ad-hoc-retry",
     "naive-marker-write", "nonfinite-launder",
     "blocking-call-in-publisher", "magic-quality-threshold",
-    "ad-hoc-timing",
+    "ad-hoc-timing", "nondeterministic-placement",
 }
 
 
@@ -225,7 +225,7 @@ def test_json_output_schema(capsys):
     payload = json.loads(capsys.readouterr().out)
     assert payload["version"] == 1
     assert payload["root"] == os.path.abspath(FIXTURES)
-    assert payload["files_scanned"] == 14
+    assert payload["files_scanned"] == 15
     assert set(payload["rules"]) >= ALL_RULES
     assert isinstance(payload["findings"], list) and payload["findings"]
     for f in payload["findings"]:

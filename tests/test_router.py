@@ -1,0 +1,1016 @@
+"""Elastic serving fleet (ISSUE 13): consistent-hash tile routing,
+fleet-aware failover, warm-state replica migration, and the chaos
+acceptance test.
+
+Acceptance pins:
+
+- the ring is STABLE (pinned digests — builtin ``hash()`` would shred
+  cross-process agreement) and rebalances MINIMALLY: adding a replica
+  moves only the tiles the new replica now owns, removing it restores
+  the previous ownership exactly;
+- a tile re-assigned to a fresh replica resumes WARM from the shared
+  checkpoint set with output bit-identical (unfused CPU) to the
+  original owner's uninterrupted run;
+- chaos: loadgen against a 3-replica fleet behind ``kafka-route``, one
+  replica SIGKILLed mid-request -> the router flags it dead within one
+  heartbeat TTL and re-routes, zero admitted requests are lost, the
+  re-served output equals the uninterrupted run's, and the
+  serve_fleet_* BENCH rows emit and gate in bench_compare.
+
+All tier-1 / CPU.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from kafka_tpu import telemetry
+from kafka_tpu.serve import (
+    AdmissionController,
+    AdmissionPolicy,
+    AssimilationService,
+    HashRing,
+    RequestJournal,
+    RoutePolicy,
+    ServeDaemon,
+    TileRouter,
+    TileSession,
+    make_synthetic_tile,
+    read_response,
+    stable_hash,
+    submit_request,
+    synthetic_dates,
+)
+from kafka_tpu.serve.router import FleetWatch
+from kafka_tpu.serve.synthetic import DEFAULT_BASE_DATE
+from kafka_tpu.telemetry import MetricsRegistry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DATES = synthetic_dates(DEFAULT_BASE_DATE, 16, 2)
+
+TILES_30 = [f"tile{i}" for i in range(30)]
+
+
+def _subprocess_env():
+    from kafka_tpu.resilience import faults
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["KAFKA_TPU_LIVE_INTERVAL_S"] = "0.2"
+    env.pop(faults.ENV_VAR, None)
+    return env
+
+
+class StubSession:
+    """Duck-typed tile session (no JAX) for router-mechanics tests."""
+
+    def __init__(self, name):
+        self.name = name
+        self.serves = 0
+
+    def serve(self, date):
+        self.serves += 1
+        return {"status": "ok", "tile": self.name,
+                "date": date.isoformat(), "x_sha256": f"stub-{self.name}"}
+
+
+class StubFleet:
+    """N in-process stub replicas (daemon threads) + helpers."""
+
+    def __init__(self, tmp_path, n=2, tiles=4, policies=None):
+        self.roots = {}
+        self.daemons = []
+        self.threads = []
+        self.sessions = {}
+        for i in range(n):
+            rid = f"rep{i}"
+            root = str(tmp_path / rid)
+            sessions = {f"tile{t}": StubSession(f"tile{t}")
+                        for t in range(tiles)}
+            self.sessions[rid] = sessions
+            policy = (policies or {}).get(
+                rid, AdmissionPolicy(max_queue_depth=64)
+            )
+            svc = AssimilationService(sessions, root, policy=policy)
+            d = ServeDaemon(svc, root, poll_interval_s=0.01)
+            self.daemons.append(d)
+            self.roots[rid] = root
+            self.threads.append(threading.Thread(
+                target=d.run, name=f"stub-{rid}", daemon=True,
+            ))
+
+    def start(self):
+        for t in self.threads:
+            t.start()
+        return self
+
+    def stop(self):
+        for d in self.daemons:
+            d.drain()
+        for t in self.threads:
+            t.join(timeout=60)
+
+
+def run_router(router):
+    t = threading.Thread(target=router.run, name="router", daemon=True)
+    t.start()
+    return t
+
+
+def wait_response(root, rid, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        got = read_response(root, rid)
+        if got is not None:
+            return got
+        time.sleep(0.01)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the stable hash + ring
+# ---------------------------------------------------------------------------
+
+class TestStableHash:
+    def test_pinned_cross_process_values(self):
+        """The digests are PINNED: any change here re-shuffles every
+        deployed fleet's keyspace (and builtin hash() could never pin —
+        it is salted per process)."""
+        assert stable_hash("tile0") == 18108283901022872304
+        assert stable_hash("rep0#0") == 245196271913887815
+        assert stable_hash("") == 16476032584258269876
+
+    def test_distinct_and_64bit(self):
+        vals = {stable_hash(t) for t in TILES_30}
+        assert len(vals) == len(TILES_30)
+        assert all(0 <= v < 2 ** 64 for v in vals)
+
+
+class TestHashRing:
+    def test_owner_deterministic_and_covering(self):
+        ring = HashRing(["a", "b", "c"])
+        asg = ring.assignments(TILES_30)
+        assert sorted(sum(asg.values(), [])) == sorted(TILES_30)
+        # Every replica owns a share (vnodes spread the segments).
+        assert all(asg[r] for r in ("a", "b", "c"))
+        ring2 = HashRing(["c", "a", "b"])  # insertion order irrelevant
+        assert {t: ring2.owner(t) for t in TILES_30} == \
+            {t: ring.owner(t) for t in TILES_30}
+
+    def test_join_moves_only_the_new_replicas_segments(self):
+        """The consistent-hashing contract: adding a replica moves ONLY
+        tiles the new replica now owns — no tile moves between the
+        survivors."""
+        ring = HashRing(["a", "b"])
+        before = {t: ring.owner(t) for t in TILES_30}
+        ring.add("c")
+        after = {t: ring.owner(t) for t in TILES_30}
+        moved = [t for t in TILES_30 if before[t] != after[t]]
+        assert moved, "join moved nothing — ring is degenerate"
+        assert all(after[t] == "c" for t in moved)
+        # ...and only a minority segment moved, not a reshuffle.
+        assert len(moved) < len(TILES_30) / 2
+
+    def test_leave_restores_previous_ownership_exactly(self):
+        ring = HashRing(["a", "b"])
+        before = {t: ring.owner(t) for t in TILES_30}
+        ring.add("c")
+        ring.remove("c")
+        assert {t: ring.owner(t) for t in TILES_30} == before
+
+    def test_preference_walk_and_exclude(self):
+        ring = HashRing(["a", "b", "c"])
+        for t in TILES_30:
+            pref = ring.preference(t)
+            assert sorted(pref) == ["a", "b", "c"]
+            assert ring.owner(t) == pref[0]
+            assert ring.owner(t, exclude=[pref[0]]) == pref[1]
+            assert ring.owner(t, exclude=pref) is None
+
+    def test_empty_ring(self):
+        ring = HashRing()
+        assert ring.owner("tile0") is None
+        assert ring.preference("tile0") == []
+
+
+# ---------------------------------------------------------------------------
+# retry_after_s backoff hints (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+class TestRetryAfterHint:
+    def test_load_state_rejections_carry_hint(self, tmp_path):
+        with telemetry.use(MetricsRegistry()):
+            svc = AssimilationService(
+                {"t": StubSession("t")}, str(tmp_path),
+                policy=AdmissionPolicy(max_queue_depth=0,
+                                       retry_after_s=1.25),
+            )
+            try:
+                ack = svc.submit({"tile": "t", "date": "2017-07-05",
+                                  "request_id": "r1"})
+                assert ack["reason"] == "queue_full"
+                assert ack["retry_after_s"] == 1.25
+                # ...and the hint reaches cross-process clients through
+                # the response file.
+                assert svc.journal.response("r1")["retry_after_s"] \
+                    == 1.25
+                svc.stop_admitting()
+                drained = svc.submit({"tile": "t",
+                                      "date": "2017-07-05",
+                                      "request_id": "r2"})
+                assert drained["reason"] == "draining"
+                assert drained["retry_after_s"] == 1.25
+            finally:
+                svc.close()
+
+    def test_request_shaped_rejections_carry_no_hint(self, tmp_path):
+        with telemetry.use(MetricsRegistry()):
+            svc = AssimilationService(
+                {"t": StubSession("t")}, str(tmp_path),
+            )
+            try:
+                bad = svc.submit({"tile": "t", "request_id": "rb"})
+                assert bad["reason"] == "bad_request"
+                assert "retry_after_s" not in bad
+                unk = svc.submit({"tile": "nope", "date": "2017-07-05",
+                                  "request_id": "ru"})
+                assert unk["reason"] == "unknown_tile"
+                assert "retry_after_s" not in unk
+            finally:
+                svc.close()
+
+    def test_admission_controller_retry_after(self):
+        ctl = AdmissionController(AdmissionPolicy(retry_after_s=0.75))
+        assert ctl.retry_after("queue_full") == 0.75
+        assert ctl.retry_after("fleet_degraded") == 0.75
+        assert ctl.retry_after("draining") == 0.75
+        assert ctl.retry_after("bad_request") is None
+        assert ctl.retry_after("unknown_tile") is None
+
+
+class TestLoadgenBackoff:
+    def test_backoff_retries_instead_of_terminal_rejection(
+            self, tmp_path):
+        """A client with backoff budget waits out queue_full and lands
+        every request; the waits are counted into serve_backoff_total."""
+        from tools.loadgen import _Target, run_load
+
+        with telemetry.use(MetricsRegistry()):
+            svc = AssimilationService(
+                {"t": StubSession("t")}, str(tmp_path),
+                policy=AdmissionPolicy(max_queue_depth=1,
+                                       retry_after_s=0.05),
+            ).start()
+            try:
+                plan = [{"tile": "t", "date": "2017-07-05"}
+                        for _ in range(8)]
+                rows = run_load(
+                    _Target(service=svc), plan, concurrency=8,
+                    timeout_s=60, backoff_budget=20,
+                )
+                assert rows["serve_ok_total"] == 8
+                assert rows["serve_rejected_total"] == 0
+                assert rows["serve_backoff_total"] >= 1
+            finally:
+                svc.close()
+
+    def test_zero_budget_keeps_fast_rejection_contract(self, tmp_path):
+        from tools.loadgen import _Target, run_load
+
+        with telemetry.use(MetricsRegistry()):
+            svc = AssimilationService(
+                {"t": StubSession("t")}, str(tmp_path),
+                policy=AdmissionPolicy(max_queue_depth=0),
+            ).start()
+            try:
+                rows = run_load(
+                    _Target(service=svc),
+                    [{"tile": "t", "date": "2017-07-05"}],
+                    concurrency=1, timeout_s=10,
+                )
+                assert rows["serve_rejected_total"] == 1
+                assert rows["serve_backoff_total"] == 0
+            finally:
+                svc.close()
+
+
+# ---------------------------------------------------------------------------
+# journal compaction (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+class TestJournalCompaction:
+    def _fill(self, j, n, answered=True, start=0):
+        for i in range(start, start + n):
+            rid = f"r{i:04d}"
+            j.record({"request_id": rid, "tile": "t",
+                      "date": "2017-07-05", "pad": "x" * 40})
+            if answered:
+                j.respond(rid, {"status": "ok"})
+
+    def test_answered_entries_rotate_into_segments(self, tmp_path):
+        with telemetry.use(MetricsRegistry()) as reg:
+            j = RequestJournal(str(tmp_path), rotate_bytes=2000, keep=2)
+            self._fill(j, 60, answered=True)
+            names = sorted(n for n in os.listdir(tmp_path)
+                           if n.startswith("requests.jsonl"))
+            assert "requests.jsonl.1" in names
+            assert "requests.jsonl.3" not in names  # keep-N enforced
+            # The live journal shrank below the cap (only pending —
+            # here none — survives in it).
+            assert os.path.getsize(j.journal_path) < 2000
+            # Segments stay line-whole JSON.
+            for n in names:
+                with open(tmp_path / n) as f:
+                    for line in f:
+                        assert json.loads(line)["tile"] == "t"
+            assert reg.value(
+                "kafka_serve_journal_compactions_total") >= 1
+            assert any(e["event"] == "journal_compacted"
+                       for e in reg.events)
+            j.close()
+
+    def test_replay_correct_across_rotation_boundary(self, tmp_path):
+        """The satellite's pin: entries answered before the rotation
+        land in segments, pending ones stay live, and replay returns
+        EXACTLY the unanswered set — wherever the boundary fell."""
+        with telemetry.use(MetricsRegistry()):
+            j = RequestJournal(str(tmp_path), rotate_bytes=600, keep=3)
+            # Interleave answered and pending entries across several
+            # rotations.
+            pending = []
+            for i in range(40):
+                rid = f"r{i:04d}"
+                j.record({"request_id": rid, "tile": "t",
+                          "date": "2017-07-05", "pad": "x" * 30})
+                if i % 5 == 0:
+                    pending.append(rid)
+                else:
+                    j.respond(rid, {"status": "ok"})
+            assert os.path.exists(str(tmp_path / "requests.jsonl.1"))
+            j.close()
+            # A fresh journal over the same root (the restart) replays
+            # exactly the pending ids, oldest first.
+            j2 = RequestJournal(str(tmp_path))
+            assert [p["request_id"] for p in j2.replay()] == pending
+            j2.close()
+
+    def test_compaction_never_rotates_pending_entries(self, tmp_path):
+        with telemetry.use(MetricsRegistry()):
+            j = RequestJournal(str(tmp_path), rotate_bytes=400, keep=2)
+            self._fill(j, 20, answered=False)
+            # Nothing answered: the journal may exceed its cap but must
+            # not lose a single pending entry to rotation.
+            assert not os.path.exists(
+                str(tmp_path / "requests.jsonl.1"))
+            assert len(j.replay()) == 20
+            j.close()
+
+    def test_no_rotation_without_cap(self, tmp_path):
+        with telemetry.use(MetricsRegistry()):
+            j = RequestJournal(str(tmp_path))
+            self._fill(j, 50, answered=True)
+            assert sorted(
+                n for n in os.listdir(tmp_path)
+                if n.startswith("requests.jsonl")
+            ) == ["requests.jsonl"]
+            j.close()
+
+
+# ---------------------------------------------------------------------------
+# router mechanics (stub replicas, no JAX)
+# ---------------------------------------------------------------------------
+
+class TestRouterMechanics:
+    def test_forward_relay_and_ring_ownership(self, tmp_path):
+        with telemetry.use(MetricsRegistry()) as reg:
+            fleet = StubFleet(tmp_path, n=2, tiles=4).start()
+            router = TileRouter(fleet.roots, str(tmp_path / "front"),
+                                poll_interval_s=0.01)
+            rt = run_router(router)
+            try:
+                rids = {}
+                for t in range(4):
+                    tile = f"tile{t}"
+                    rids[tile] = submit_request(
+                        str(tmp_path / "front"),
+                        {"tile": tile, "date": "2017-07-05"},
+                    )
+                ring = HashRing(fleet.roots)
+                for tile, rid in rids.items():
+                    got = wait_response(str(tmp_path / "front"), rid)
+                    assert got is not None and got["status"] == "ok"
+                    # The relay stamps WHICH replica answered, and it
+                    # is the ring owner.
+                    assert got["replica"] == ring.owner(tile)
+                    assert got["x_sha256"] == f"stub-{tile}"
+                assert reg.value("kafka_route_relayed_total") == 4
+                # The router view facts cover the routed tiles.
+                st = router.status()
+                assert sorted(sum(st["router_ring"].values(), [])) == \
+                    [f"tile{t}" for t in range(4)]
+                assert st["router_inflight"] == 0
+            finally:
+                router.drain()
+                rt.join(timeout=30)
+                fleet.stop()
+
+    def test_shedding_replica_rerouted_to_survivor(self, tmp_path):
+        """A replica answering ``rejected: queue_full`` is NOT the end
+        of the request: the router re-forwards to the next replica on
+        the ring (which serves the tile warm from the shared
+        checkpoints) and deprioritises the shedder."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            ring = HashRing(["rep0", "rep1"])
+            # Find a tile owned by each replica so we can shed exactly
+            # the owner of the tile we request.
+            asg = ring.assignments([f"tile{t}" for t in range(4)])
+            tile = asg["rep0"][0] if asg["rep0"] else asg["rep1"][0]
+            shedder = ring.owner(tile)
+            fleet = StubFleet(
+                tmp_path, n=2, tiles=4,
+                policies={shedder: AdmissionPolicy(max_queue_depth=0)},
+            ).start()
+            router = TileRouter(fleet.roots, str(tmp_path / "front"),
+                                poll_interval_s=0.01)
+            rt = run_router(router)
+            try:
+                rid = submit_request(
+                    str(tmp_path / "front"),
+                    {"tile": tile, "date": "2017-07-05"},
+                )
+                got = wait_response(str(tmp_path / "front"), rid)
+                assert got is not None and got["status"] == "ok"
+                assert got["replica"] != shedder
+                assert reg.value("kafka_route_rerouted_total",
+                                 reason="rejected") >= 1
+            finally:
+                router.drain()
+                rt.join(timeout=30)
+                fleet.stop()
+
+    def test_all_replicas_shedding_rejects_with_retry_after(
+            self, tmp_path):
+        with telemetry.use(MetricsRegistry()):
+            fleet = StubFleet(
+                tmp_path, n=2, tiles=2,
+                policies={
+                    "rep0": AdmissionPolicy(max_queue_depth=0),
+                    "rep1": AdmissionPolicy(max_queue_depth=0),
+                },
+            ).start()
+            router = TileRouter(
+                fleet.roots, str(tmp_path / "front"),
+                policy=RoutePolicy(retry_after_s=0.9),
+                poll_interval_s=0.01,
+            )
+            rt = run_router(router)
+            try:
+                rid = submit_request(
+                    str(tmp_path / "front"),
+                    {"tile": "tile0", "date": "2017-07-05"},
+                )
+                got = wait_response(str(tmp_path / "front"), rid)
+                assert got is not None
+                assert got["status"] == "rejected"
+                assert got["reason"] == "fleet_degraded"
+                assert got["retry_after_s"] == 0.9
+            finally:
+                router.drain()
+                rt.join(timeout=30)
+                fleet.stop()
+
+    def test_router_restart_replays_unanswered(self, tmp_path):
+        """Zero admitted requests lost across a ROUTER crash: the
+        journal replays unanswered requests on restart and re-forwards
+        them."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            root0 = str(tmp_path / "rep0")
+            front = str(tmp_path / "front")
+            # First router life: no daemon behind rep0, so the forward
+            # lands in an inbox nobody serves.
+            router1 = TileRouter({"rep0": root0}, front,
+                                 poll_interval_s=0.01)
+            ack = router1.submit({"tile": "tile0",
+                                  "date": "2017-07-05",
+                                  "request_id": "lost1"})
+            assert ack["status"] == "queued"
+            router1.journal.close()
+            # "Restart": the replica daemon is up now; the new router
+            # replays the journal and the request completes.
+            fleet = StubFleet(tmp_path, n=1, tiles=1).start()
+            router2 = TileRouter(fleet.roots, front,
+                                 poll_interval_s=0.01)
+            rt = run_router(router2)
+            try:
+                got = wait_response(front, "lost1")
+                assert got is not None and got["status"] == "ok"
+                assert reg.value("kafka_route_replayed_total") == 1
+            finally:
+                router2.drain()
+                rt.join(timeout=30)
+                fleet.stop()
+
+    def test_draining_router_rejects_with_hint(self, tmp_path):
+        with telemetry.use(MetricsRegistry()):
+            router = TileRouter({"rep0": str(tmp_path / "rep0")},
+                                str(tmp_path / "front"))
+            router.drain()
+            ack = router.submit({"tile": "tile0",
+                                 "date": "2017-07-05",
+                                 "request_id": "late"})
+            assert ack["status"] == "rejected"
+            assert ack["reason"] == "draining"
+            assert ack["retry_after_s"] == router.policy.retry_after_s
+            router.journal.close()
+
+    def test_bad_request_rejected_not_forwarded(self, tmp_path):
+        with telemetry.use(MetricsRegistry()) as reg:
+            router = TileRouter({"rep0": str(tmp_path / "rep0")},
+                                str(tmp_path / "front"))
+            ack = router.submit({"date": "2017-07-05",
+                                 "request_id": "nob"})
+            assert ack["status"] == "rejected"
+            assert ack["reason"] == "bad_request"
+            assert "retry_after_s" not in ack
+            assert reg.value("kafka_route_rejected_total",
+                             reason="bad_request") == 1
+            # Not journaled: a bad request is not admitted work.
+            assert router.journal.replay() == []
+            router.journal.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet watch: dead / shedding detection from live snapshots
+# ---------------------------------------------------------------------------
+
+def _write_snapshot(fleet_dir, host, pid, serve_root, ts, final=False,
+                    interval_s=0.2, counters=None, gauges=None,
+                    role="serve"):
+    os.makedirs(fleet_dir, exist_ok=True)
+    snap = {
+        "schema": 1, "ts": ts, "host": host, "pid": pid, "role": role,
+        "seq": 1, "interval_s": interval_s, "final": final,
+        "run_id": None, "chunk_id": None,
+        "health": {"unhealthy": None}, "quality": {}, "perf": {},
+        "counters": counters or {}, "gauges": gauges or {},
+        "histograms": {}, "series_truncated": 0, "crash_dumps": [],
+        "status": {"serve_root": os.path.abspath(serve_root)},
+    }
+    path = os.path.join(fleet_dir, f"live_{host}_{pid}.json")
+    with open(path, "w") as f:
+        json.dump(snap, f)
+    return snap
+
+
+class TestFleetWatch:
+    def test_stale_heartbeat_without_final_is_dead(self, tmp_path):
+        fleet_dir = str(tmp_path / "tel")
+        roots = {"rep0": str(tmp_path / "rep0"),
+                 "rep1": str(tmp_path / "rep1"),
+                 "rep2": str(tmp_path / "rep2")}
+        now = time.time()
+        _write_snapshot(fleet_dir, "h", 1, roots["rep0"], ts=now - 30)
+        _write_snapshot(fleet_dir, "h", 2, roots["rep1"], ts=now)
+        # rep2 exited CLEANLY long ago: final, so never "dead".
+        _write_snapshot(fleet_dir, "h", 3, roots["rep2"], ts=now - 30,
+                        final=True)
+        watch = FleetWatch(fleet_dir, roots, RoutePolicy(ttl_s=1.0))
+        view = watch.refresh()
+        assert view["rep0"]["dead"] is True
+        assert view["rep1"]["dead"] is False
+        assert view["rep2"]["dead"] is False
+        assert view["rep2"]["final"] is True
+
+    def test_default_ttl_is_three_heartbeats(self, tmp_path):
+        fleet_dir = str(tmp_path / "tel")
+        roots = {"rep0": str(tmp_path / "rep0")}
+        now = time.time()
+        # interval 2.0 -> TTL 6.0: a 4s-old heartbeat is alive, a 7s-old
+        # one is dead.
+        _write_snapshot(fleet_dir, "h", 1, roots["rep0"], ts=now - 4,
+                        interval_s=2.0)
+        watch = FleetWatch(fleet_dir, roots, RoutePolicy())
+        assert watch.refresh()["rep0"]["dead"] is False
+        _write_snapshot(fleet_dir, "h", 1, roots["rep0"], ts=now - 7,
+                        interval_s=2.0)
+        assert watch.refresh()["rep0"]["dead"] is True
+
+    def test_queue_full_counter_climb_marks_shedding(self, tmp_path):
+        fleet_dir = str(tmp_path / "tel")
+        roots = {"rep0": str(tmp_path / "rep0")}
+        tag = 'kafka_serve_rejected_total{reason="queue_full"}'
+        _write_snapshot(fleet_dir, "h", 1, roots["rep0"],
+                        ts=time.time(), counters={tag: 2})
+        watch = FleetWatch(fleet_dir, roots,
+                           RoutePolicy(ttl_s=5.0, shed_backoff_s=30.0))
+        watch.refresh()  # baseline
+        assert watch.shedding("rep0") is False
+        _write_snapshot(fleet_dir, "h", 1, roots["rep0"],
+                        ts=time.time(), counters={tag: 5})
+        watch.refresh()
+        assert watch.shedding("rep0") is True
+
+    def test_dead_replica_triggers_failover_and_rebalance(
+            self, tmp_path):
+        """In-process failover: requests in flight on a replica whose
+        heartbeat went stale are re-forwarded to the survivor, and the
+        ring rebalance is counted."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            fleet_dir = str(tmp_path / "tel")
+            fleet = StubFleet(tmp_path, n=2, tiles=4).start()
+            ring = HashRing(fleet.roots)
+            tile = ring.assignments(
+                [f"tile{t}" for t in range(4)])["rep0"][0]
+            now = time.time()
+            # rep0 looks freshly alive; rep1 alive too.
+            _write_snapshot(fleet_dir, "h", 10, fleet.roots["rep0"],
+                            ts=now)
+            _write_snapshot(fleet_dir, "h", 11, fleet.roots["rep1"],
+                            ts=now)
+            router = TileRouter(
+                dict(fleet.roots), str(tmp_path / "front"),
+                fleet_dir=fleet_dir,
+                policy=RoutePolicy(ttl_s=1.0, refresh_s=0.05),
+                poll_interval_s=0.01,
+            )
+            # Stop rep0's daemon so the forward stays unanswered, then
+            # let its heartbeat go stale.
+            fleet.daemons[0].drain()
+            fleet.threads[0].join(timeout=30)
+            rt = run_router(router)
+            try:
+                rid = submit_request(
+                    str(tmp_path / "front"),
+                    {"tile": tile, "date": "2017-07-05"},
+                )
+                time.sleep(0.1)
+                # The heartbeat goes stale NOW (older than TTL).
+                _write_snapshot(fleet_dir, "h", 10,
+                                fleet.roots["rep0"], ts=now - 60)
+                got = wait_response(str(tmp_path / "front"), rid,
+                                    timeout_s=30)
+                assert got is not None and got["status"] == "ok"
+                assert got["replica"] == "rep1"
+                assert reg.value("kafka_route_rerouted_total",
+                                 reason="dead") >= 1
+                assert reg.value("kafka_route_rebalanced_total") >= 1
+                st = router.status()
+                assert st["router_dead"] == ["rep0"]
+                assert st["router_last_failover_ts"] is not None
+            finally:
+                router.drain()
+                rt.join(timeout=30)
+                fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# warm-state replica migration (ISSUE 13 satellite; real solve)
+# ---------------------------------------------------------------------------
+
+class TestWarmMigration:
+    def test_reassigned_tile_resumes_warm_and_bit_identical(
+            self, tmp_path):
+        """A tile re-assigned to a FRESH replica resumes from the
+        shared checkpoint set: zero windows re-run for an already-
+        answered date, and the continued chain is bit-identical to the
+        original owner's uninterrupted run (unfused CPU)."""
+        with telemetry.use(MetricsRegistry()):
+            shared_ckpt = str(tmp_path / "ckpt_shared")
+
+            def session():
+                # A fresh replica's view of the SAME tile: same spec,
+                # same shared checkpoint dir.
+                return TileSession(make_synthetic_tile(
+                    "t", shared_ckpt, seed=0))
+
+            # DATES[0]/DATES[3]/DATES[-1] sit in DISTINCT 4-day grid
+            # windows, so each serve advances the chain.
+            owner_a = session()
+            r1 = owner_a.serve(DATES[0])
+            r2 = owner_a.serve(DATES[3])
+            assert r2["served_from"] == "warm"
+
+            # Migration: replica B picks the tile up mid-chain.
+            owner_b = session()
+            noop = owner_b.serve(DATES[3])
+            assert noop["served_from"] == "warm_noop"
+            assert noop["windows_run"] == 0
+            assert noop["x_sha256"] == r2["x_sha256"]
+            cont = owner_b.serve(DATES[-1])
+            assert cont["served_from"] == "warm"
+
+            # The migrated chain equals an uninterrupted single-owner
+            # chain, bit for bit.
+            ref = TileSession(make_synthetic_tile(
+                "t", str(tmp_path / "ckpt_ref"), seed=0))
+            ref.serve(DATES[0])
+            ref.serve(DATES[3])
+            ref_final = ref.serve(DATES[-1])
+            assert cont["x_sha256"] == ref_final["x_sha256"]
+            assert r1["x_sha256"] == \
+                TileSession(make_synthetic_tile(
+                    "t", str(tmp_path / "ckpt_cold"), seed=0,
+                )).serve(DATES[0])["x_sha256"]
+
+
+# ---------------------------------------------------------------------------
+# fleet_status router view (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+class TestFleetStatusRouterView:
+    def _router_snapshot(self, fleet_dir):
+        os.makedirs(fleet_dir, exist_ok=True)
+        snap = {
+            "schema": 1, "ts": time.time(), "host": "rhost", "pid": 77,
+            "role": "route", "seq": 3, "interval_s": 2.0,
+            "final": False, "run_id": None, "chunk_id": None,
+            "health": {"unhealthy": None}, "quality": {}, "perf": {},
+            "counters": {"kafka_route_relayed_total": 9},
+            "gauges": {"kafka_route_inflight": 2},
+            "histograms": {}, "series_truncated": 0, "crash_dumps": [],
+            "status": {
+                "router_root": "/front",
+                "router_replicas": {"rep0": "/r0", "rep1": "/r1",
+                                    "rep2": "/r2"},
+                "router_routable": ["rep0", "rep1"],
+                "router_dead": ["rep2"],
+                "router_ring": {"rep0": ["tile0", "tile3"],
+                                "rep1": ["tile1", "tile2"],
+                                "rep2": []},
+                "router_inflight": 2,
+                "router_rerouted_total": 4,
+                "router_rebalanced_total": 1,
+                "router_last_failover_ts": 1700000000.0,
+            },
+        }
+        with open(os.path.join(fleet_dir, "live_rhost_77.json"),
+                  "w") as f:
+            json.dump(snap, f)
+
+    def test_render_includes_ring_and_failover(self, tmp_path):
+        from tools.fleet_status import build_view, render
+
+        self._router_snapshot(str(tmp_path))
+        fleet = build_view(str(tmp_path), ttl_s=60.0)
+        text = render(fleet)
+        assert "router rhost:77" in text
+        assert "routable=2/3" in text
+        assert "inflight=2" in text
+        assert "rerouted=4" in text
+        assert "rebalanced=1" in text
+        assert "dead replicas: rep2" in text
+        assert "ring rep0: 2 tile(s) [tile0,tile3]" in text
+        assert "ring rep2 DEAD: 0 tile(s)" in text
+        # A timestamp rendered, not the '-' placeholder (the exact
+        # date text is timezone-dependent).
+        assert "last_failover=-" not in text
+        assert "last_failover=20" in text
+
+    def test_cli_json_carries_router_status(self, tmp_path, capsys):
+        from tools.fleet_status import main
+
+        self._router_snapshot(str(tmp_path))
+        assert main([str(tmp_path), "--json", "--ttl-s", "60"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        worker = payload["workers"][0]
+        assert worker["role"] == "route"
+        assert worker["status"]["router_rerouted_total"] == 4
+
+
+# ---------------------------------------------------------------------------
+# bench rows + bench_compare gate (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+class TestFleetBenchRows:
+    def test_bench_fleet_rows(self, tmp_path):
+        from tools.loadgen import bench_fleet
+
+        with telemetry.use(MetricsRegistry()):
+            rows = bench_fleet(str(tmp_path), replicas=2, requests=6,
+                               concurrency=2, tiles=2)
+        assert rows["serve_fleet_ok_total"] == 6
+        assert rows["serve_fleet_error_total"] == 0
+        assert rows["serve_fleet_p50_ms"] > 0
+        assert rows["serve_fleet_p99_ms"] >= rows["serve_fleet_p50_ms"]
+        assert rows["serve_fleet_replicas"] == 2
+        assert rows["serve_fleet_rerouted_total"] == 0
+        assert rows["serve_fleet_cold_ms"] > 0
+        assert rows["serve_backoff_total"] == 0
+
+    def test_bench_compare_gates_fleet_p99(self, tmp_path, capsys):
+        from tools.bench_compare import main as compare
+
+        base = {"serve_fleet_p50_ms": 5.0, "serve_fleet_p99_ms": 20.0,
+                "serve_fleet_rerouted_total": 0}
+        old = tmp_path / "old.json"
+        new = tmp_path / "new.json"
+        old.write_text(json.dumps(base))
+        # >10% p99 regression fails the gate.
+        new.write_text(json.dumps({**base,
+                                   "serve_fleet_p99_ms": 30.0}))
+        assert compare([str(old), str(new)]) == 1
+        err = capsys.readouterr().err
+        assert "serve_fleet_p99_ms" in err and "REGRESSION" in err
+        # Disappearance of the row gates too.
+        new.write_text(json.dumps({"serve_fleet_p50_ms": 5.0}))
+        assert compare([str(old), str(new)]) == 1
+        # Within threshold passes; rerouted_total is informational.
+        new.write_text(json.dumps({**base,
+                                   "serve_fleet_p99_ms": 21.0,
+                                   "serve_fleet_rerouted_total": 99}))
+        assert compare([str(old), str(new)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# the chaos acceptance: 3-replica fleet, SIGKILL one mid-request
+# ---------------------------------------------------------------------------
+
+def _replica_cmd(root, ckpt_root, tel_dir):
+    return [
+        sys.executable, "-m", "kafka_tpu.cli.kafka_serve",
+        "--root", str(root), "--ckpt-root", str(ckpt_root),
+        "--tiles", "2", "--operator", "identity",
+        "--ny", "16", "--nx", "20", "--days", "40", "--step", "2",
+        "--obs-every", "2", "--poll-interval-s", "0.02",
+        "--telemetry-dir", str(tel_dir),
+    ]
+
+
+def _router_cmd(front, replicas, fleet_dir, tel_dir):
+    spec = ",".join(f"{rid}={root}" for rid, root in replicas.items())
+    return [
+        sys.executable, "-m", "kafka_tpu.cli.kafka_route",
+        "--root", str(front), "--replicas", spec,
+        "--fleet-dir", str(fleet_dir), "--ttl-s", "1.0",
+        "--refresh-s", "0.2", "--poll-interval-s", "0.02",
+        "--telemetry-dir", str(tel_dir),
+    ]
+
+
+class TestFleetChaosAcceptance:
+    def test_sigkill_replica_rerouted_warm_zero_loss(self, tmp_path):
+        """ISSUE 13 acceptance: loadgen against a 3-replica fleet
+        behind kafka-route; the replica owning tile0 is SIGKILLed
+        mid-request.  The router flags it dead within one heartbeat TTL
+        and re-routes, the reassigned owner resumes the tile WARM from
+        the shared checkpoint set, zero admitted requests are lost, the
+        served output equals an uninterrupted run's bit-for-bit, and
+        the serve_fleet_* rows emit."""
+        from tools.loadgen import _Target, run_load
+
+        env = _subprocess_env()
+        tel = tmp_path / "tel"
+        ckpt = tmp_path / "ckpt"
+        front = str(tmp_path / "front")
+        dates = synthetic_dates(DEFAULT_BASE_DATE, 40, 2)
+        date = dates[-1]
+
+        replicas = {f"rep{i}": str(tmp_path / f"rep{i}")
+                    for i in range(3)}
+        victim_rid = HashRing(replicas).owner("tile0")
+        procs = {}
+        router_proc = None
+        try:
+            for rid, root in replicas.items():
+                procs[rid] = subprocess.Popen(
+                    _replica_cmd(root, ckpt, tel / rid), env=env,
+                    cwd=REPO_ROOT, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                )
+            router_proc = subprocess.Popen(
+                _router_cmd(front, replicas, tel, tel / "router"),
+                env=env, cwd=REPO_ROOT,
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True,
+            )
+            victim = procs[victim_rid]
+
+            rid = submit_request(front, {
+                "tile": "tile0", "date": date.isoformat(),
+                "request_id": "victimreq",
+            })
+            # Kill the owner as soon as warm state exists (shared
+            # checkpoints on disk) and the request is admitted by it
+            # (victim journal) but unanswered: mid-request by
+            # construction.
+            victim_journal = tmp_path / victim_rid / "requests.jsonl"
+            ck_dir = ckpt / "ckpt_tile0"
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                if victim.poll() is not None:
+                    pytest.fail(
+                        f"victim exited rc={victim.returncode} before "
+                        "it could be killed"
+                    )
+                if read_response(front, rid) is not None:
+                    pytest.fail("fleet answered before the kill — "
+                                "widen the request")
+                journal_text = victim_journal.read_text() \
+                    if victim_journal.exists() else ""
+                if rid in journal_text and ck_dir.is_dir() and any(
+                        n.endswith(".npz")
+                        for n in os.listdir(ck_dir)):
+                    break
+                time.sleep(0.005)
+            else:
+                pytest.fail("victim never admitted + checkpointed")
+            kill_ts = time.time()
+            victim.kill()
+            victim.wait(timeout=30)
+            assert read_response(front, rid) is None
+
+            # The router must flag the victim dead and re-route; the
+            # reassigned owner resumes warm and answers.
+            got = wait_response(front, rid, timeout_s=300)
+            assert got is not None, "re-routed request was lost"
+            assert got["status"] == "ok"
+            assert got["replica"] != victim_rid
+            # Warm migration: the new owner resumed from the victim's
+            # checkpoints, not a cold rerun.
+            assert got["served_from"] in ("warm", "warm_noop")
+
+            # ...and the answer equals an uninterrupted run's, exactly
+            # (bit-identical unfused CPU).
+            ref = TileSession(make_synthetic_tile(
+                "tile0", str(tmp_path / "ck_ref"), operator="identity",
+                ny=16, nx=20, days=40, step_days=2, obs_every=2,
+                seed=0,
+            ))
+            assert got["x_sha256"] == ref.serve(date)["x_sha256"]
+
+            # Zero lost admitted requests under continued load: every
+            # post-failover request lands (the fleet is one replica
+            # down but fully serving).
+            plan = []
+            for i in range(6):
+                plan.append({
+                    "tile": f"tile{i % 2}",
+                    "date": dates[-1 - (i % 2)].isoformat(),
+                })
+            rows = run_load(_Target(root=front), plan, concurrency=3,
+                            timeout_s=300, backoff_budget=8)
+            assert rows["serve_ok_total"] == 6
+            assert rows["serve_error_total"] == 0
+            # The serve_fleet_* BENCH rows this harness emits.
+            fleet_rows = {
+                "serve_fleet_p50_ms": rows["serve_p50_ms"],
+                "serve_fleet_p99_ms": rows["serve_p99_ms"],
+                "serve_fleet_rerouted_total": None,
+            }
+            assert fleet_rows["serve_fleet_p99_ms"] is not None
+            assert fleet_rows["serve_fleet_p99_ms"] >= \
+                fleet_rows["serve_fleet_p50_ms"]
+
+            # Drain the router cleanly and read its summary: it
+            # re-routed (failover) and rebalanced the ring.
+            router_proc.send_signal(signal.SIGTERM)
+            out, _ = router_proc.communicate(timeout=120)
+            assert router_proc.returncode == 0
+            summary = json.loads(out.strip().splitlines()[-1])
+            assert summary["rerouted"] >= 1
+            assert summary["rebalanced"] >= 1
+            assert summary["relayed"] >= 7  # victimreq + the 6 loadgen
+
+            # Failover latency: the router noticed within TTL-scale
+            # time of the victim's LAST heartbeat (TTL 1.0s + refresh
+            # 0.2s + scheduling slack).
+            events_path = tel / "router" / "events.jsonl"
+            failovers = []
+            with open(events_path) as f:
+                for line in f:
+                    e = json.loads(line)
+                    if e["event"] == "route_failover":
+                        failovers.append(e)
+            assert failovers, "router recorded no failover event"
+            victim_snaps = [
+                n for n in os.listdir(tel / victim_rid)
+                if n.startswith("live_")
+            ]
+            assert victim_snaps, "victim published no heartbeat"
+            with open(tel / victim_rid / victim_snaps[0]) as f:
+                last_beat = json.load(f)["ts"]
+            detect_lag = failovers[0]["ts"] - last_beat
+            assert detect_lag <= 1.0 + 0.2 + 8.0, (
+                f"failover took {detect_lag:.1f}s after the last "
+                "heartbeat — far beyond one heartbeat TTL"
+            )
+            assert failovers[0]["ts"] >= kill_ts
+
+            # The fleet view agrees: exactly the victim is dead.
+            from tools.fleet_status import build_view
+
+            fleet_view = build_view(str(tel), ttl_s=1.0)
+            dead_pids = {w["pid"] for w in fleet_view["workers"]
+                         if w["dead"]}
+            assert victim.pid in dead_pids
+        finally:
+            for proc in list(procs.values()) + [router_proc]:
+                if proc is not None and proc.poll() is None:
+                    proc.kill()

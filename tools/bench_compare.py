@@ -42,11 +42,15 @@ from typing import Dict, List, Optional, Tuple
 
 #: timing rows gated on regression (smaller is better, milliseconds).
 #: ``device_*_ms`` are the solve rows; ``serve_p50_ms``/``serve_p99_ms``
-#: are the serving-latency rows (tools/loadgen.py) — the serving story
-#: gates like the solve story.  ``serve_cold_ms``/``serve_rejected_*``
-#: stay informational (cold start is setup; rejections are a policy
-#: outcome, not a latency).
-GATED_ROW_PATTERNS = ("device_*_ms", "serve_p50_ms", "serve_p99_ms")
+#: are the serving-latency rows (tools/loadgen.py) and
+#: ``serve_fleet_p50_ms``/``serve_fleet_p99_ms`` their elastic-fleet
+#: twins (N replicas behind the consistent-hash router) — the serving
+#: story gates like the solve story.  ``serve_cold_ms``/
+#: ``serve_*rejected*``/``serve_fleet_rerouted_total`` stay
+#: informational (cold start is setup; rejections and re-routes are
+#: policy outcomes, not latencies).
+GATED_ROW_PATTERNS = ("device_*_ms", "serve_p50_ms", "serve_p99_ms",
+                      "serve_fleet_p50_ms", "serve_fleet_p99_ms")
 DEVICE_ROW_PATTERN = GATED_ROW_PATTERNS[0]  # back-compat alias
 
 

@@ -64,6 +64,7 @@ def render(fleet: dict) -> str:
         )
     if fleet["dead_hosts"]:
         lines.append(f"dead hosts: {', '.join(fleet['dead_hosts'])}")
+    lines.extend(_render_routers(fleet))
     fq = fleet.get("quality") or {}
     if fq.get("drifting_workers"):
         lines.append(
@@ -106,6 +107,49 @@ def render(fleet: dict) -> str:
         for c in fleet["crash_dumps"]:
             lines.append(f"  {c['worker']}: {c['file']}")
     return "\n".join(lines)
+
+
+def _render_routers(fleet: dict) -> list:
+    """The router view (ISSUE 13): for every ``kafka-route`` worker in
+    the fleet, its ring ownership per replica, tiles in flight, the
+    re-route / rebalance counters and the last failover timestamp —
+    read from the ``router_*`` status facts the router publishes with
+    each live snapshot."""
+    import datetime
+
+    lines = []
+    for w in fleet.get("workers") or ():
+        st = w.get("status") or {}
+        if w.get("role") != "route" and "router_ring" not in st:
+            continue
+        failover = st.get("router_last_failover_ts")
+        failover_txt = "-" if not failover else \
+            datetime.datetime.fromtimestamp(failover).isoformat(
+                timespec="seconds"
+            )
+        lines.append(
+            f"router {w['key']}: "
+            f"routable={len(st.get('router_routable') or ())}/"
+            f"{len(st.get('router_replicas') or ())} "
+            f"inflight={st.get('router_inflight', 0)} "
+            f"rerouted={st.get('router_rerouted_total', 0)} "
+            f"rebalanced={st.get('router_rebalanced_total', 0)} "
+            f"last_failover={failover_txt}"
+        )
+        dead = st.get("router_dead") or []
+        if dead:
+            lines.append(f"  dead replicas: {', '.join(dead)}")
+        ring = st.get("router_ring") or {}
+        for rid in sorted(ring):
+            tiles = ring[rid]
+            shown = ",".join(tiles[:6]) + \
+                (",..." if len(tiles) > 6 else "")
+            marker = " DEAD" if rid in dead else ""
+            lines.append(
+                f"  ring {rid}{marker}: {len(tiles)} tile(s)"
+                + (f" [{shown}]" if tiles else "")
+            )
+    return lines
 
 
 def build_view(root: str, ttl_s=None, queue_dir=None) -> dict:

@@ -177,13 +177,20 @@ def run_load(
     requests: List[dict],
     concurrency: int = 8,
     timeout_s: float = 300.0,
+    backoff_budget: int = 0,
+    backoff_cap_s: float = 5.0,
 ) -> dict:
     """Fire ``requests`` with ``concurrency`` client threads; returns
     the serving rows.  A rejected submission is terminal immediately
-    (that IS the response — fast rejection is the overload contract);
-    everything else waits for its response file."""
+    (that IS the response — fast rejection is the overload contract) —
+    UNLESS the rejection carries a ``retry_after_s`` backoff hint and
+    ``backoff_budget`` > 0, in which case the client waits the hinted
+    time and resubmits (each wait counted into ``serve_backoff_total``,
+    at most ``backoff_budget`` waits per request) instead of hammering
+    a shedding replica."""
     results = []
     health_totals: dict = {}
+    backoff_total = [0]
     lock = threading.Lock()
     it = iter(list(enumerate(requests)))
 
@@ -196,22 +203,50 @@ def run_load(
             i, payload = nxt
             payload = dict(payload)
             payload.setdefault("request_id", f"load{i:05d}")
+            base_id = payload["request_id"]
             t0 = time.perf_counter()
-            ack = target.submit(payload)
-            if ack.get("status") == "rejected":
+            backoffs = 0
+            while True:
+                ack = target.submit(payload)
+                got = None
+                if ack.get("status") != "rejected":
+                    got = target.result(payload["request_id"],
+                                        timeout_s=timeout_s)
+                rejected = ack if ack.get("status") == "rejected" else (
+                    got if got is not None
+                    and got.get("status") == "rejected" else None
+                )
+                if rejected is not None:
+                    hint = rejected.get("retry_after_s")
+                    if hint and backoffs < backoff_budget:
+                        backoffs += 1
+                        # Fresh id per retry: in the filesystem
+                        # transport a stale rejected response file must
+                        # not alias the resubmission's answer.
+                        payload["request_id"] = f"{base_id}b{backoffs}"
+                        # kafkalint: disable=ad-hoc-retry — honouring
+                        # the server's retry_after_s hint IS the backoff
+                        # protocol; the wait length is the server's
+                        # decision, not a client policy.
+                        time.sleep(min(float(hint), backoff_cap_s))
+                        continue
+                    with lock:
+                        backoff_total[0] += backoffs
+                        results.append(
+                            ("rejected", rejected.get("reason"), 0.0)
+                        )
+                    break
+                wall_ms = (time.perf_counter() - t0) * 1e3
+                status = "timeout" if got is None \
+                    else got.get("status", "?")
+                health = (got or {}).get("solver_health") or {}
                 with lock:
-                    results.append(("rejected", ack.get("reason"), 0.0))
-                continue
-            got = target.result(payload["request_id"],
-                                timeout_s=timeout_s)
-            wall_ms = (time.perf_counter() - t0) * 1e3
-            status = "timeout" if got is None else got.get("status", "?")
-            health = (got or {}).get("solver_health") or {}
-            with lock:
-                results.append((status, None, wall_ms))
-                for key, v in health.items():
-                    health_totals[key] = health_totals.get(key, 0) + \
-                        int(v or 0)
+                    backoff_total[0] += backoffs
+                    results.append((status, None, wall_ms))
+                    for key, v in health.items():
+                        health_totals[key] = \
+                            health_totals.get(key, 0) + int(v or 0)
+                break
 
     threads = [
         # kafkalint: disable=untracked-thread — loadgen threads are the
@@ -240,6 +275,9 @@ def run_load(
         "serve_error_total": count("error") + count("timeout"),
         "serve_rps": round(n_ok / wall_s, 2) if wall_s > 0 else None,
         "serve_wall_s": round(wall_s, 3),
+        # Backoff waits taken on retry_after_s rejection hints — the
+        # client-side view of admission shedding under load.
+        "serve_backoff_total": backoff_total[0],
         # Result QUALITY rows, summed over answered requests from the
         # per-response solver_health blocks: latency numbers alone would
         # hide a service answering fast with quarantined pixels.
@@ -332,15 +370,132 @@ def bench_serve(
         service.close()
 
 
+def bench_fleet(
+    tmpdir: str,
+    replicas: int = 3,
+    requests: int = 24,
+    concurrency: int = 4,
+    tiles: int = 4,
+    backoff_budget: int = 4,
+) -> dict:
+    """Self-contained FLEET bench (the ``bench.py`` embed's elastic
+    twin of :func:`bench_serve`): N in-process kafka-serve replicas
+    over a SHARED checkpoint root, fronted by a consistent-hash
+    ``TileRouter``, all driven through the router's filesystem
+    transport — the serve_fleet_* BENCH rows measure the one serving
+    surface a client of the elastic fleet actually sees."""
+    import os
+
+    from kafka_tpu.serve import (
+        AdmissionPolicy, AssimilationService, ServeDaemon, TileRouter,
+        TileSession, make_synthetic_tile, synthetic_dates,
+    )
+    from kafka_tpu.serve.synthetic import DEFAULT_BASE_DATE
+    from kafka_tpu.telemetry import get_registry
+
+    ckpt_root = os.path.join(tmpdir, "ckpt")
+    tile_names = [f"tile{t}" for t in range(max(1, tiles))]
+    replica_roots = {}
+    daemons = []
+    threads = []
+    for i in range(max(2, replicas)):
+        root = os.path.join(tmpdir, f"rep{i}")
+        sessions = {
+            name: TileSession(make_synthetic_tile(
+                name,
+                ckpt_dir=os.path.join(ckpt_root, f"ckpt_{name}"),
+                seed=t,
+            ))
+            for t, name in enumerate(tile_names)
+        }
+        svc = AssimilationService(
+            sessions, root,
+            policy=AdmissionPolicy(
+                max_queue_depth=max(64, requests + 1)
+            ),
+        )
+        daemons.append(ServeDaemon(svc, root, poll_interval_s=0.01))
+        replica_roots[f"rep{i}"] = root
+        # kafkalint: disable=untracked-thread — bench-harness carrier
+        # for an in-process replica daemon; the daemon's own service
+        # worker follows the tracing convention.
+        threads.append(threading.Thread(
+            target=daemons[-1].run, name=f"fleet-rep{i}", daemon=True,
+        ))
+    router_root = os.path.join(tmpdir, "router")
+    router = TileRouter(replica_roots, router_root,
+                        poll_interval_s=0.01)
+    # kafkalint: disable=untracked-thread — bench-harness carrier for
+    # the in-process router loop.
+    router_thread = threading.Thread(
+        target=router.run, name="fleet-router", daemon=True,
+    )
+    for t in threads:
+        t.start()
+    router_thread.start()
+    dates = synthetic_dates(DEFAULT_BASE_DATE, days=16, obs_every=2)
+    target = _Target(root=router_root)
+    try:
+        t0 = time.perf_counter()
+        warm = run_load(
+            target,
+            [{"tile": n, "date": dates[-1].isoformat()}
+             for n in tile_names],
+            concurrency=2, timeout_s=600.0,
+        )
+        cold_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        if warm["serve_ok_total"] != len(tile_names):
+            raise RuntimeError(f"fleet warm-up failed: {warm}")
+        plan = synthetic_request_plan(dates[-4:], tile_names, requests)
+        rows = run_load(
+            target, plan, concurrency=concurrency, timeout_s=600.0,
+            backoff_budget=backoff_budget,
+        )
+        flat = get_registry().flat()
+        rerouted = int(sum(
+            v for k, v in flat.items()
+            if k.startswith("kafka_route_rerouted_total")
+        ))
+        return {
+            "serve_fleet_p50_ms": rows["serve_p50_ms"],
+            "serve_fleet_p99_ms": rows["serve_p99_ms"],
+            "serve_fleet_requests_total": rows["serve_requests_total"],
+            "serve_fleet_ok_total": rows["serve_ok_total"],
+            "serve_fleet_rejected_total": rows["serve_rejected_total"],
+            "serve_fleet_error_total": rows["serve_error_total"],
+            "serve_fleet_rps": rows["serve_rps"],
+            "serve_fleet_rerouted_total": rerouted,
+            "serve_fleet_replicas": len(replica_roots),
+            "serve_fleet_cold_ms": cold_ms,
+            "serve_backoff_total": rows["serve_backoff_total"],
+        }
+    finally:
+        router.drain()
+        router_thread.join(timeout=120.0)
+        for d in daemons:
+            d.drain()
+        for t in threads:
+            t.join(timeout=120.0)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--root", default=None,
-                    help="serve root of a RUNNING kafka-serve daemon")
+                    help="serve root of a RUNNING kafka-serve daemon "
+                         "(or kafka-route front door)")
     ap.add_argument("--synthetic", action="store_true",
                     help="self-contained in-process service (default "
                          "when --root is not given)")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="self-contained ELASTIC-FLEET mode: N "
+                         "in-process replicas behind a consistent-hash "
+                         "router, emitting the serve_fleet_* rows")
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--backoff", type=int, default=0, metavar="K",
+                    help="honor retry_after_s rejection hints with up "
+                         "to K backoff waits per request (counted into "
+                         "serve_backoff_total)")
     ap.add_argument("--tiles", default="tile0",
                     help="comma-separated tile names (--root mode)")
     ap.add_argument("--dates", default=None,
@@ -378,6 +533,7 @@ def main(argv=None) -> int:
         rows = run_load(
             _Target(root=args.root), plan,
             concurrency=args.concurrency, timeout_s=args.timeout_s,
+            backoff_budget=args.backoff,
         )
         if scraper is not None:
             rows["live_telemetry"] = scraper.stop()
@@ -387,14 +543,23 @@ def main(argv=None) -> int:
 
         tmp = tempfile.mkdtemp(prefix="kafka_loadgen_")
         try:
-            rows = bench_serve(
-                tmp, requests=args.requests,
-                concurrency=args.concurrency,
-            )
+            if args.fleet:
+                rows = bench_fleet(
+                    tmp, replicas=args.fleet, requests=args.requests,
+                    concurrency=args.concurrency,
+                    backoff_budget=args.backoff or 4,
+                )
+            else:
+                rows = bench_serve(
+                    tmp, requests=args.requests,
+                    concurrency=args.concurrency,
+                )
         finally:
             shutil.rmtree(tmp, ignore_errors=True)
     print(json.dumps(rows))
-    return 1 if rows["serve_error_total"] else 0
+    errors = rows.get("serve_error_total",
+                      rows.get("serve_fleet_error_total", 0))
+    return 1 if errors else 0
 
 
 if __name__ == "__main__":
