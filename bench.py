@@ -444,6 +444,15 @@ def assemble_result(
         else serve.get("serve_rejected_total"),
         "serve_requests_total": None if serve is None
         else serve.get("serve_requests_total"),
+        # Request-tracing rows (ISSUE 14, tools/loadgen): fraction of
+        # served requests whose per-request trace attributes >=95% of
+        # their wall time, and the single slowest request — the
+        # observability-coverage health of the serving path, diffed
+        # informationally by tools/bench_compare.py (no gate yet).
+        "serve_trace_coverage": None if serve is None
+        else serve.get("serve_trace_coverage"),
+        "serve_slowest_ms": None if serve is None
+        else serve.get("serve_slowest_ms"),
         # Mid-run /metrics scrape of the serving bench (tools/loadgen's
         # _MetricsScraper against the ephemeral telemetry.httpd
         # endpoint): how queue depth and admission counters MOVED under

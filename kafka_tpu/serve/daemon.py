@@ -48,9 +48,14 @@ INBOX_DIR = "inbox"
 
 def submit_request(root: str, payload: dict) -> str:
     """Atomically drop one request into a daemon's inbox; returns the
-    request id (generated when the payload carries none)."""
+    request id (generated when the payload carries none).  The client
+    submission stamp makes the inbox wait attributable: without it the
+    server would start the request's clock at parse time and the time
+    the file sat in ``inbox/`` would be invisible to the per-request
+    trace (ISSUE 14 admission_wait)."""
     payload = dict(payload)
     payload.setdefault("request_id", new_request_id())
+    payload.setdefault("submitted_ts", round(time.time(), 6))
     inbox = os.path.join(root, INBOX_DIR)
     os.makedirs(inbox, exist_ok=True)
     name = f"{payload['request_id']}.json"

@@ -35,6 +35,12 @@ class BadRequest(ValueError):
 
 
 def new_request_id() -> str:
+    """THE sanctioned request-id origin (kafkalint rule 17
+    ``request-id-origin``): a request id doubles as the per-request
+    trace key, so it must be minted exactly once — here, at admission —
+    and propagated on the wire.  A second minting site anywhere in
+    ``serve/`` would fork the trace: the router's spans and the
+    replica's spans would carry different ids for one request."""
     return os.urandom(8).hex()
 
 
@@ -52,16 +58,26 @@ class ServeRequest:
     #: interrupted, so its age must not cancel it.
     deadline: Optional[Deadline] = None
     replayed: bool = False
+    #: wall-clock admission stamp (set by the admitting process, rides
+    #: the journal line and the wire so admission_wait attribution and
+    #: trace continuation survive crash replay and forwarding).
+    admitted_ts: Optional[float] = None
+    #: perf_counter reading at enqueue (process-local, NOT serialised) —
+    #: the queue_wait span's start endpoint.
+    admitted_perf: Optional[float] = None
 
     def payload(self) -> dict:
         """The journal line (and the client-visible echo)."""
-        return {
+        out = {
             "request_id": self.request_id,
             "tile": self.tile,
             "date": self.date.isoformat(),
             "deadline_s": self.deadline_s,
             "submitted_ts": round(self.submitted_ts, 6),
         }
+        if self.admitted_ts is not None:
+            out["admitted_ts"] = round(self.admitted_ts, 6)
+        return out
 
 
 def parse_date(text) -> datetime.datetime:
@@ -111,6 +127,9 @@ def parse_request(payload, default_tile: Optional[str] = None,
     submitted = payload.get("submitted_ts")
     if not isinstance(submitted, (int, float)):
         submitted = time.time()
+    admitted = payload.get("admitted_ts")
+    if not isinstance(admitted, (int, float)):
+        admitted = None
     deadline = None
     if deadline_s is not None and not replayed:
         deadline = Deadline(deadline_s)
@@ -118,4 +137,5 @@ def parse_request(payload, default_tile: Optional[str] = None,
         request_id=request_id, tile=tile, date=date,
         deadline_s=deadline_s, submitted_ts=float(submitted),
         deadline=deadline, replayed=replayed,
+        admitted_ts=None if admitted is None else float(admitted),
     )

@@ -51,7 +51,8 @@ import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from ..resilience import faults
-from ..telemetry import get_registry, live
+from ..telemetry import get_registry, live, request_log, tracing
+from ..telemetry.tracing import trace_span
 from .daemon import INBOX_DIR, _install_drain, _restore_drain, \
     read_response, submit_request
 from .journal import RequestJournal
@@ -318,6 +319,15 @@ class _InFlight:
     replica: str
     admitted_ts: float
     tried: List[str]
+    #: wall-clock stamp of the LAST forward (this attempt) and of the
+    #: FIRST — their difference is the failover_ms phase: the time the
+    #: request lost to dead/shedding replicas before landing.
+    forwarded_ts: float = 0.0
+    first_forward_ts: float = 0.0
+    #: reroute history ({"reason", "replica", "held_ms"} per hop) —
+    #: rides the response trace and the request_log row (failover
+    #: forensics).
+    reroutes: List[dict] = dataclasses.field(default_factory=list)
 
 
 class TileRouter:
@@ -505,8 +515,22 @@ class TileRouter:
         for rid in victims:
             inf = self._inflight.pop(rid)
             self._m["rerouted"].inc(reason="dead")
-            self._forward(inf.payload, inf.tile, inf.admitted_ts,
-                          tried=inf.tried + [inf.replica])
+            held_ms = max(0.0, time.time() - inf.forwarded_ts) * 1e3
+            reroutes = inf.reroutes + [{
+                "reason": "dead", "replica": inf.replica,
+                "held_ms": round(held_ms, 3),
+            }]
+            # The failover is a named span ON the request's trace: the
+            # stitched waterfall shows router-side re-forwarding, not a
+            # gap, and trace_report attributes the added tail latency
+            # to the failover phase.
+            with tracing.push(request_id=rid), \
+                    trace_span("route_failover", tile=inf.tile,
+                               replica=inf.replica):
+                self._forward(inf.payload, inf.tile, inf.admitted_ts,
+                              tried=inf.tried + [inf.replica],
+                              reroutes=reroutes,
+                              first_forward_ts=inf.first_forward_ts)
         self._set_inflight()
 
     def _publish_status(self) -> None:
@@ -532,12 +556,19 @@ class TileRouter:
             # Duplicate submission of an in-flight id: the original
             # forward already covers it.
             return {"request_id": req.request_id, "status": "queued"}
-        self.journal.record(req.payload())
+        req.admitted_ts = time.time()
+        with tracing.push(request_id=req.request_id), \
+                trace_span("route_admit", tile=req.tile):
+            self.journal.record(req.payload())
         get_registry().emit(
             "route_admitted", request_id=req.request_id, tile=req.tile,
         )
+        request_log.note_inflight(
+            req.request_id, tile=req.tile, date=req.date.isoformat(),
+            stage="routing", submitted_ts=req.submitted_ts,
+        )
         self._tiles_seen.add(req.tile)
-        return self._forward(req.payload(), req.tile, time.time())
+        return self._forward(req.payload(), req.tile, req.admitted_ts)
 
     def _candidates(self, tile: str,
                     exclude: Iterable[str]) -> List[str]:
@@ -552,19 +583,32 @@ class TileRouter:
         return good + [rid for rid in alive if rid not in good]
 
     def _forward(self, payload: dict, tile: str, admitted_ts: float,
-                 tried: Optional[List[str]] = None) -> dict:
+                 tried: Optional[List[str]] = None,
+                 reroutes: Optional[List[dict]] = None,
+                 first_forward_ts: Optional[float] = None) -> dict:
         tried = list(tried or ())
         rid = payload["request_id"]
         candidates = self._candidates(tile, tried)
         if not candidates:
-            return self._reject(rid, "fleet_degraded")
+            return self._reject(rid, "fleet_degraded", admitted=True,
+                                tile=tile)
         target = candidates[0]
         faults.fault_point("route.forward", request=rid, replica=target)
-        submit_request(self.replica_roots[target], payload)
+        now = time.time()
+        with tracing.push(request_id=rid), \
+                trace_span("route_forward", tile=tile, replica=target,
+                           attempt=len(tried) + 1):
+            submit_request(self.replica_roots[target], payload)
         self._inflight[rid] = _InFlight(
             payload=payload, tile=tile, replica=target,
             admitted_ts=admitted_ts, tried=tried,
+            forwarded_ts=now,
+            first_forward_ts=(first_forward_ts if first_forward_ts
+                              is not None else now),
+            reroutes=list(reroutes or ()),
         )
+        request_log.note_inflight(rid, stage="forwarded",
+                                  replica=target)
         self._m["forwarded"].inc(replica=target)
         self._set_inflight()
         get_registry().emit(
@@ -575,7 +619,8 @@ class TileRouter:
                 "replica": target}
 
     def _reject(self, request_id: Optional[str], reason: str,
-                detail: Optional[str] = None) -> dict:
+                detail: Optional[str] = None, admitted: bool = False,
+                tile: Optional[str] = None) -> dict:
         self._m["rejected"].inc(reason=reason)
         get_registry().emit(
             "route_rejected", request_id=str(request_id), reason=reason,
@@ -592,6 +637,14 @@ class TileRouter:
             except OSError as exc:
                 LOG.warning("could not write router rejection for %s: "
                             "%r", request_id, exc)
+            if admitted:
+                # An ADMITTED request that ends rejected (fleet fully
+                # degraded) still gets its wide event — 100% of
+                # admitted requests leave a request_log row.
+                request_log.record(request_log.build_record(
+                    "route", request_id, status="rejected",
+                    e2e_ms=None, tile=tile, reason=reason,
+                ))
         return ack
 
     # -- relay ----------------------------------------------------------
@@ -620,26 +673,109 @@ class TileRouter:
                     "route_rerouted", request_id=rid,
                     replica=inf.replica, reason=reason,
                 )
+                held_ms = max(0.0,
+                              time.time() - inf.forwarded_ts) * 1e3
                 ack = self._forward(
                     inf.payload, inf.tile, inf.admitted_ts,
                     tried=inf.tried + [inf.replica],
+                    reroutes=inf.reroutes + [{
+                        "reason": reason, "replica": inf.replica,
+                        "held_ms": round(held_ms, 3),
+                    }],
+                    first_forward_ts=inf.first_forward_ts,
                 )
                 if ack["status"] == "rejected":
                     settled += 1
                 continue
             body = dict(got)
             body["replica"] = inf.replica
-            self.journal.respond(rid, body)
+            body["trace"] = self._merged_trace(rid, inf, got)
+            with tracing.push(request_id=rid), \
+                    trace_span("route_relay", replica=inf.replica):
+                self.journal.respond(rid, body)
             del self._inflight[rid]
             self._m["relayed"].inc()
             if got.get("status") == "ok":
                 self._m["latency"].observe(
                     max(0.0, time.time() - inf.admitted_ts)
                 )
+            self._record_request(rid, inf, body)
             settled += 1
         if settled:
             self._set_inflight()
         return settled
+
+    def _merged_trace(self, rid: str, inf: _InFlight, got: dict) -> dict:
+        """The client-visible per-request attribution, end to end: the
+        router's waits composed with the replica's phases into ONE
+        non-overlapping breakdown of submit -> relay.
+
+        The replica's own ``admission_wait_ms`` is dropped (it spans the
+        ORIGINAL client submit, which overlaps the router's admission
+        and forward phases); ``forward_ms`` (last forward -> replica
+        admission, the filesystem-wire hop) and ``relay_ms`` (replica
+        publish -> this relay) replace it, and ``failover_ms`` (first
+        forward -> last forward) accounts for every dead/shedding hop —
+        the phase a SIGKILL's added tail latency lands in.
+        """
+        t_relay = time.time()
+        rep = got.get("trace") if isinstance(got.get("trace"), dict) \
+            else {}
+        rep_phases = rep.get("phases") or {}
+        submitted = float(inf.payload.get("submitted_ts")
+                          or inf.admitted_ts)
+        phases = {
+            # Everything before the FIRST forward: client inbox wait,
+            # parse, journal fsync — admission seen from the client.
+            "admission_wait_ms":
+                max(0.0, inf.first_forward_ts - submitted) * 1e3,
+        }
+        if inf.reroutes:
+            phases["failover_ms"] = max(
+                0.0, inf.forwarded_ts - inf.first_forward_ts,
+            ) * 1e3
+        rep_admitted = rep.get("admitted_ts")
+        if isinstance(rep_admitted, (int, float)):
+            phases["forward_ms"] = \
+                max(0.0, rep_admitted - inf.forwarded_ts) * 1e3
+        for key in ("queue_wait_ms", "resume_ms", "solve_ms",
+                    "dump_ms"):
+            if isinstance(rep_phases.get(key), (int, float)):
+                phases[key] = rep_phases[key]
+        responded = rep.get("responded_ts")
+        if isinstance(responded, (int, float)):
+            phases["relay_ms"] = max(0.0, t_relay - responded) * 1e3
+        trace = {
+            "request_id": rid,
+            "phases": {k: round(v, 3) for k, v in phases.items()},
+            "e2e_ms": round(max(0.0, t_relay - submitted) * 1e3, 3),
+        }
+        if inf.reroutes:
+            trace["reroutes"] = list(inf.reroutes)
+        if rep.get("replayed"):
+            trace["replayed"] = True
+        return trace
+
+    def _record_request(self, rid: str, inf: _InFlight,
+                        body: dict) -> None:
+        """The router half of request_log.jsonl: one wide event per
+        relayed request, with the merged end-to-end phases and the
+        reroute history attached."""
+        trace = body.get("trace") or {}
+        request_log.record(request_log.build_record(
+            "route", rid, status=body.get("status", "?"),
+            e2e_ms=trace.get("e2e_ms"), phases=trace.get("phases"),
+            tile=inf.tile, date=body.get("date"),
+            served_from=body.get("served_from"),
+            replica=inf.replica,
+            reroutes=trace.get("reroutes"),
+            solver_health=body.get("solver_health"),
+            quality=body.get("quality"),
+        ))
+
+    def requestz(self, n: int = 32) -> dict:
+        """The ``/requestz`` payload: in-flight + last-N relayed."""
+        return request_log.requestz(n)
 
     def _set_inflight(self) -> None:
         self._m["inflight"].set(len(self._inflight))

@@ -45,6 +45,8 @@ from ..resilience import (
     faults,
 )
 from ..telemetry import get_registry, tracing
+from ..telemetry import request_log
+from ..telemetry.tracing import trace_span
 from .admission import AdmissionController, AdmissionPolicy
 from .journal import RequestJournal
 from .request import BadRequest, ServeRequest, parse_request
@@ -177,6 +179,15 @@ class AssimilationService:
                 "request_replayed", request_id=req.request_id,
                 tile=req.tile, date=req.date.isoformat(),
             )
+            # The replay CONTINUES the journaled trace (same request
+            # id, original submission/admission stamps) — it does not
+            # mint a fresh one; queue_wait restarts at re-enqueue.
+            req.admitted_perf = time.perf_counter()
+            request_log.note_inflight(
+                req.request_id, tile=req.tile,
+                date=req.date.isoformat(), stage="queued",
+                replayed=True,
+            )
             with self._cond:
                 self._queue.append(req)
         self._set_depth()
@@ -252,14 +263,31 @@ class AssimilationService:
             return self._reject(req.request_id, "unknown_tile")
         if self._draining.is_set() or self._stopped.is_set():
             return self._reject(req.request_id, "draining")
-        with self._cond:
-            reason = self.admission.decide(queue_depth=len(self._queue))
-            if reason is None:
-                self.journal.record(req.payload())
-                self._queue.append(req)
-                self._m["admitted"].inc()
-                self._set_depth_locked()
-                self._cond.notify_all()
+        with tracing.push(request_id=req.request_id), \
+                trace_span("serve_admit", tile=req.tile):
+            with self._cond:
+                reason = self.admission.decide(
+                    queue_depth=len(self._queue)
+                )
+                if reason is None:
+                    # The admission stamp rides the journal line and the
+                    # trace: admission_wait attribution survives crash
+                    # replay and (via the wire) re-forwarding.
+                    req.admitted_ts = time.time()
+                    req.admitted_perf = time.perf_counter()
+                    self.journal.record(req.payload())
+                    # In-flight BEFORE the worker can dequeue it (we
+                    # hold the queue lock): a request must never finish
+                    # before /requestz saw it start.
+                    request_log.note_inflight(
+                        req.request_id, tile=req.tile,
+                        date=req.date.isoformat(), stage="queued",
+                        submitted_ts=req.submitted_ts,
+                    )
+                    self._queue.append(req)
+                    self._m["admitted"].inc()
+                    self._set_depth_locked()
+                    self._cond.notify_all()
         if reason is not None:
             return self._reject(req.request_id, reason)
         get_registry().emit(
@@ -333,8 +361,51 @@ class AssimilationService:
                     self._cond.notify_all()
 
     def _process(self, req: ServeRequest) -> None:
+        # Request-scoped trace context: every span from here down —
+        # queue_wait, serve_resume, the engine's own phases, the
+        # respond write — carries the request id, so the stitched
+        # per-request waterfall is one filter away.
+        with tracing.push(request_id=req.request_id):
+            self._process_traced(req)
+
+    def _wait_phases(self, req: ServeRequest, t_deq: float) -> Dict:
+        """The two pre-solve phases: admission_wait (client submit ->
+        admission decision, wall clock — cross-process on the
+        filesystem transport) and queue_wait (admission -> this
+        dequeue).  The queue_wait also lands as a retroactive span so
+        the waterfall shows the queue, not a gap."""
+        admitted = req.admitted_ts if req.admitted_ts is not None \
+            else req.submitted_ts
+        phases = {
+            "admission_wait_ms":
+                max(0.0, admitted - req.submitted_ts) * 1e3,
+        }
+        if req.admitted_perf is not None:
+            phases["queue_wait_ms"] = \
+                max(0.0, t_deq - req.admitted_perf) * 1e3
+            get_registry().trace.add_span(
+                "queue_wait", req.admitted_perf, t_deq, cat="phase",
+                tile=req.tile,
+            )
+        return phases
+
+    def _trace_block(self, req: ServeRequest, phases: Dict) -> dict:
+        """The response's ``trace`` stamp (finalised in _respond: the
+        dump phase and e2e close when the answer is published)."""
+        return {
+            "request_id": req.request_id,
+            "phases": {k: round(v, 3) for k, v in phases.items()},
+            "admitted_ts": req.admitted_ts,
+            "replayed": req.replayed,
+            "_anchor_perf": time.perf_counter(),
+        }
+
+    def _process_traced(self, req: ServeRequest) -> None:
         reg = get_registry()
         key = (req.tile, req.date.isoformat())
+        t_deq = time.perf_counter()
+        phases = self._wait_phases(req, t_deq)
+        request_log.note_inflight(req.request_id, stage="solving")
         try:
             if req.deadline is not None:
                 req.deadline.check(f"request {req.request_id}")
@@ -345,18 +416,19 @@ class AssimilationService:
                 tile=req.tile, date=req.date.isoformat(),
                 waited_s=round(time.time() - req.submitted_ts, 3),
             )
-            self._respond(req, {
+            self._finish(req, {
                 "status": "cancelled", "reason": "deadline",
                 "detail": str(exc), "tile": req.tile,
                 "date": req.date.isoformat(),
-            })
+            }, phases)
             return
         cached = self._cache.get(key)
         if cached is not None:
             self._m["cache_hits"].inc()
             body = dict(cached)
+            body.pop("trace", None)
             body["served_from"] = "cache"
-            self._finish_ok(req, body)
+            self._finish_ok(req, body, phases)
             return
 
         def solve():
@@ -366,7 +438,13 @@ class AssimilationService:
             return self.sessions[req.tile].serve(req.date)
 
         try:
-            with tracing.push(window_id=req.request_id):
+            if req.replayed:
+                # Satellite: a journal-replayed request shows a visible
+                # `replayed` span continuing the original trace — not a
+                # fresh waterfall under a fresh id.
+                with trace_span("replayed", tile=req.tile):
+                    body = self._retry.call(solve, site="serve.solve")
+            else:
                 body = self._retry.call(solve, site="serve.solve")
         except BaseException as exc:
             if classify_failure(exc) == FATAL:
@@ -377,22 +455,38 @@ class AssimilationService:
                 tile=req.tile, date=req.date.isoformat(),
                 error=repr(exc)[:300],
             )
-            self._respond(req, {
+            self._finish(req, {
                 "status": "error", "error": repr(exc)[:300],
                 "tile": req.tile, "date": req.date.isoformat(),
-            })
+            }, phases)
             return
+        body = dict(body)
+        phases.update(body.pop("trace_phases", {}))
         self._cache[key] = body
         self._cache.move_to_end(key)
         while len(self._cache) > self._cache_size:
             self._cache.popitem(last=False)
-        self._finish_ok(req, body)
+        self._finish_ok(req, body, phases)
 
-    def _finish_ok(self, req: ServeRequest, body: dict) -> None:
+    def _finish(self, req: ServeRequest, body: dict,
+                phases: Dict) -> None:
+        """Terminal path for cancelled/error responses: stamp the
+        trace, publish, record the wide event."""
+        body = dict(body)
+        body.pop("trace_phases", None)
+        body["trace"] = self._trace_block(req, phases)
+        self._respond(req, body)
+        self._record_request(req, body)
+
+    def _finish_ok(self, req: ServeRequest, body: dict,
+                   phases: Optional[Dict] = None) -> None:
         latency = time.time() - req.submitted_ts
         body = dict(body)
+        body.pop("trace_phases", None)
         body["request_id"] = req.request_id
         body["latency_ms"] = round(latency * 1e3, 3)
+        if phases is not None:
+            body["trace"] = self._trace_block(req, phases)
         if not req.replayed:
             self._m["latency"].observe(latency)
         get_registry().emit(
@@ -402,9 +496,49 @@ class AssimilationService:
             latency_ms=body["latency_ms"],
         )
         self._respond(req, body)
+        self._record_request(req, body)
+
+    def _record_request(self, req: ServeRequest, body: dict) -> None:
+        """One wide event per finished admitted request — the replica
+        half of request_log.jsonl (the router writes its own with the
+        relay/failover phases folded in)."""
+        trace = body.get("trace") or {}
+        request_log.record(request_log.build_record(
+            "serve", req.request_id,
+            status=body.get("status", "?"),
+            e2e_ms=trace.get("e2e_ms", body.get("latency_ms")),
+            phases=trace.get("phases"),
+            tile=req.tile, date=req.date.isoformat(),
+            served_from=body.get("served_from"),
+            replayed=req.replayed or None,
+            solver_health=body.get("solver_health"),
+            quality=body.get("quality"),
+        ))
+
+    def requestz(self, n: int = 32) -> dict:
+        """The ``/requestz`` payload: in-flight + last-N completed."""
+        return request_log.requestz(n)
 
     def _respond(self, req: ServeRequest, body: dict) -> None:
         body.setdefault("request_id", req.request_id)
+        trace = body.get("trace")
+        if isinstance(trace, dict):
+            # Close the attribution window at publish time: dump picks
+            # up everything since the solve returned (packing, cache
+            # bookkeeping, serialisation prep); e2e_ms is the SERVER's
+            # submit->publish wall, the denominator trace_report and
+            # loadgen's serve_trace_coverage use.
+            anchor = trace.pop("_anchor_perf", None)
+            if anchor is not None:
+                trace["phases"]["dump_ms"] = round(
+                    trace["phases"].get("dump_ms", 0.0)
+                    + max(0.0, time.perf_counter() - anchor) * 1e3, 3,
+                )
+            now = time.time()
+            trace["responded_ts"] = round(now, 6)
+            trace["e2e_ms"] = round(
+                max(0.0, now - req.submitted_ts) * 1e3, 3,
+            )
 
         def write():
             faults.fault_point("serve.respond", request=req.request_id)

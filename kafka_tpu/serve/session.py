@@ -121,13 +121,17 @@ class TileSession:
             )
 
     def _serve_in_context(self, kf, x0, p_inv0, output, date, t0) -> dict:
+        phases = {}
         try:
             if date not in set(kf.observations.dates):
                 raise UnknownDateError(
                     f"tile {self.name} has no observation on {date}"
                 )
-            grid = self.spec.grid_through(date)
-            resumed, seed = self.checkpointer.resume_time_grid(grid)
+            with span("serve_resume"):
+                grid = self.spec.grid_through(date)
+                resumed, seed = self.checkpointer.resume_time_grid(grid)
+            phases["resume_ms"] = (time.perf_counter() - t0) * 1e3
+            t_solve = time.perf_counter()
             if seed is None:
                 served_from = "cold"
                 windows_run = len(grid) - 1
@@ -160,10 +164,12 @@ class TileSession:
                         checkpointer=self.checkpointer,
                         advance_first=True,
                     )
+            phases["solve_ms"] = (time.perf_counter() - t_solve) * 1e3
         finally:
             close = getattr(output, "close", None)
             if close is not None:
                 close()
+        t_dump = time.perf_counter()
         x_np = np.asarray(x, np.float32)
         n_valid = kf.gather.n_valid
         x_valid = np.ascontiguousarray(x_np[:n_valid])
@@ -175,7 +181,12 @@ class TileSession:
         health = self._solver_health(kf)
         qual = self._quality(kf)
         self._record(served_from, windows_run, wall_ms, health)
+        phases["dump_ms"] = (time.perf_counter() - t_dump) * 1e3
         return {
+            # Session-local phase durations (resume / solve / dump) —
+            # consumed by the service, which folds its own waits in and
+            # replaces this with the response's "trace" block.
+            "trace_phases": {k: round(v, 3) for k, v in phases.items()},
             "status": "ok",
             "tile": self.name,
             "date": date.isoformat(),

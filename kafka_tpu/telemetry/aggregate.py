@@ -366,8 +366,54 @@ def _trace_matches(doc: dict, run_id: Optional[str]) -> bool:
     )
 
 
+def _request_events(doc: dict, request_id: str) -> Optional[dict]:
+    """``doc`` filtered to one request's events (metadata kept so the
+    track names survive); None when the fragment never saw the
+    request — that process plays no part in this waterfall."""
+    matched = [
+        e for e in doc.get("traceEvents") or ()
+        if (e.get("args") or {}).get("request_id") == request_id
+    ]
+    if not matched:
+        return None
+    meta = [e for e in doc.get("traceEvents") or ()
+            if e.get("ph") == "M"]
+    return {**doc, "traceEvents": meta + matched}
+
+
+def request_flow_events(events: List[dict]) -> List[dict]:
+    """Chrome flow events (``ph: "s"``/``"f"``) threading one request's
+    spans across process boundaries: every time the request's timeline
+    hops pids (router -> replica -> router), an arrow binds the last
+    span on the old track to the first span on the new one — the
+    forward/relay hops read as one path in Perfetto, not three
+    disconnected tracks."""
+    spans = sorted(
+        (e for e in events if e.get("ph") == "X"),
+        key=lambda e: (e.get("ts", 0), -(e.get("dur") or 0)),
+    )
+    flows: List[dict] = []
+    flow_id = 1
+    for prev, nxt in zip(spans, spans[1:]):
+        if prev.get("pid") == nxt.get("pid"):
+            continue
+        base = {"name": "request", "cat": "flow", "id": flow_id}
+        flows.append({
+            **base, "ph": "s",
+            "ts": round(prev.get("ts", 0) + (prev.get("dur") or 0), 1),
+            "pid": prev.get("pid"), "tid": prev.get("tid"),
+        })
+        flows.append({
+            **base, "ph": "f", "bp": "e",
+            "ts": nxt.get("ts", 0),
+            "pid": nxt.get("pid"), "tid": nxt.get("tid"),
+        })
+        flow_id += 1
+    return flows
+
+
 def stitch_traces(root: str, run_id: Optional[str] = None,
-                  ) -> dict:
+                  request_id: Optional[str] = None) -> dict:
     """Merge every per-process ``trace.json`` under ``root`` (optionally
     only fragments carrying ``run_id``) into ONE Chrome trace document.
 
@@ -376,6 +422,11 @@ def stitch_traces(root: str, run_id: Optional[str] = None,
     shared wall-clock axis via the ``epoch_unix_s`` anchor every
     ``TraceBuffer`` exports — cross-process ordering (claim, crash,
     reclaim) is real, not per-process-relative.
+
+    ``request_id`` stitches ONE request's waterfall instead (ISSUE 14):
+    only spans carrying that id survive (plus track metadata), only
+    processes that touched the request contribute a track, and flow
+    events thread the forward/relay hops across the pid boundaries.
     """
     sources: List[Tuple[str, dict]] = []
     for path in find_trace_files(root):
@@ -386,8 +437,14 @@ def stitch_traces(root: str, run_id: Optional[str] = None,
             continue
         if not isinstance(doc, dict) or "traceEvents" not in doc:
             continue
-        if _trace_matches(doc, run_id):
-            sources.append((path, doc))
+        if not _trace_matches(doc, run_id):
+            continue
+        if request_id is not None:
+            filtered = _request_events(doc, request_id)
+            if filtered is None:
+                continue
+            doc = filtered
+        sources.append((path, doc))
     if sources:
         epoch0 = min(
             float((doc.get("otherData") or {}).get("epoch_unix_s") or 0)
@@ -427,12 +484,15 @@ def stitch_traces(root: str, run_id: Optional[str] = None,
             "path": os.path.relpath(path, root).replace(os.sep, "/"),
             "epoch_unix_s": epoch,
         })
+    if request_id is not None:
+        events.extend(request_flow_events(events))
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
         "otherData": {
             "stitched": True,
             "run_id_filter": run_id,
+            "request_id_filter": request_id,
             "run_ids": sorted(run_ids),
             "sources": out_sources,
         },

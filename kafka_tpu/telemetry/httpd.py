@@ -28,6 +28,12 @@ loopback by default:
     One capture at a time (409 while busy); 503 with a reason when the
     profiler cannot run here (no telemetry dir, profiler unavailable) —
     never a crash of the run being observed.
+``/requestz``
+    the serving layer's last-N request view (``telemetry.request_log``):
+    in-flight requests with their stage, plus completed ones with
+    status / served_from / phase durations — human text by default,
+    JSON via ``?json=1``, ``?n=K`` bounds the list.  Served on both
+    ``kafka-serve`` and ``kafka-route``.
 
 **Port 0 = disabled** at the CLI layer (:func:`maybe_start`): the
 endpoint is opt-in, a batch run should not open sockets.  The class
@@ -137,10 +143,12 @@ class TelemetryHTTPd:
                 self._statusz(req, reg)
             elif path == "/profilez":
                 self._profilez(req, reg, parse_qs(parsed.query))
+            elif path == "/requestz":
+                self._requestz(req, reg, parse_qs(parsed.query))
             elif path == "/":
                 self._send_json(req, 200, {
                     "endpoints": ["/metrics", "/healthz", "/statusz",
-                                  "/profilez"],
+                                  "/profilez", "/requestz"],
                 })
             else:
                 self._send_json(req, 404, {"error": f"no such endpoint "
@@ -218,6 +226,44 @@ class TelemetryHTTPd:
             self._send_json(req, 503, {"error": str(exc)})
             return
         self._send_json(req, 200, {"ok": True, **result})
+
+    def _requestz(self, req, reg, query: Dict[str, list]) -> None:
+        """Last-N in-flight and completed requests (the serving
+        layer's per-request view, ``telemetry.request_log``)."""
+        from . import request_log
+
+        try:
+            n = int(query.get("n", ["32"])[0])
+        except ValueError:
+            self._send_json(req, 400, {"error": "n must be an integer"})
+            return
+        payload = request_log.requestz(n, registry=reg)
+        if query.get("json", ["0"])[0] in ("1", "true"):
+            self._send_json(req, 200, payload)
+            return
+        lines = [f"{len(payload['inflight'])} in flight, "
+                 f"{len(payload['recent'])} recent"]
+        for r in payload["inflight"]:
+            lines.append(
+                f"  INFLIGHT {r.get('request_id')} "
+                f"tile={r.get('tile')} stage={r.get('stage')}"
+                + (f" replica={r['replica']}" if r.get("replica")
+                   else "")
+            )
+        for r in payload["recent"]:
+            phases = r.get("phases") or {}
+            worst = max(phases, key=phases.get) if phases else None
+            e2e = r.get("e2e_ms")
+            lines.append(
+                f"  {r.get('request_id')} {r.get('status')}"
+                + (f" {r['served_from']}" if r.get("served_from")
+                   else "")
+                + (f" {e2e:.1f}ms" if isinstance(e2e, (int, float))
+                   else "")
+                + (f" worst={worst}({phases[worst]:.1f}ms)"
+                   if worst else "")
+            )
+        self._send(req, 200, "\n".join(lines) + "\n")
 
     def _statusz(self, req, reg) -> None:
         ctx = self._run_context()

@@ -177,6 +177,7 @@ class LivePublisher:
         self._registry = registry
         self._lock = threading.Lock()
         self._seq = 0
+        self._trace_len = -1
         self._stop = threading.Event()
         # Cross-thread trace propagation (PR 3 convention): capture the
         # constructing thread's context, re-install it on the worker.
@@ -232,6 +233,21 @@ class LivePublisher:
                 except OSError:  # tmp never materialised — nothing held
                     pass
                 return None
+            # Trace persistence rides the heartbeat: re-export the
+            # span timeline whenever it grew, so a SIGKILLed process
+            # (which never reaches registry.dump()) still leaves its
+            # last-beat trace.json behind for per-request stitching —
+            # the victim track of a failover forensics session.
+            n_trace = len(reg.trace)
+            if n_trace != self._trace_len and n_trace and \
+                    reg.directory:
+                try:
+                    reg.trace.export(
+                        os.path.join(reg.directory, "trace.json")
+                    )
+                    self._trace_len = n_trace
+                except OSError:
+                    pass  # same contract as the snapshot: degrade, never kill
         reg.counter(
             "kafka_live_snapshots_total",
             "live telemetry snapshots published by this process",

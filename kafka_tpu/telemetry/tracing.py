@@ -60,11 +60,20 @@ def new_run_id() -> str:
 
 @dataclasses.dataclass(frozen=True)
 class TraceContext:
-    """Correlation ids attached to every span/event recorded under it."""
+    """Correlation ids attached to every span/event recorded under it.
+
+    ``request_id`` is the serving layer's per-request trace key (ISSUE
+    14): minted once at admission (``serve.request.new_request_id``, the
+    sanctioned origin) and propagated on the filesystem wire — request
+    payloads, journal entries, response bodies — so every span the
+    router, the replica and the engine record for one request stitches
+    into one cross-process waterfall (``aggregate.stitch_traces``).
+    """
 
     run_id: str
     chunk_id: Optional[str] = None
     window_id: Optional[int] = None
+    request_id: Optional[str] = None
     parent_span: Optional[int] = None
 
     def fields(self) -> Dict[str, Any]:
@@ -266,10 +275,15 @@ class TraceBuffer:
         }
 
     def export(self, path: str) -> str:
-        """Write the Perfetto-openable ``trace.json``."""
+        """Write the Perfetto-openable ``trace.json`` atomically (unique
+        tmp + ``os.replace``): the live publisher re-exports it every
+        heartbeat so a SIGKILLed process leaves its last-beat trace
+        behind, and a stitching reader must never see a torn file."""
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        with open(path, "w") as f:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(self.to_chrome(), f, default=str)
+        os.replace(tmp, path)
         return path
 
 

@@ -28,6 +28,7 @@ EXPECTED_KEYS = [
     "e2e_device_fraction", "e2e_n_pixels",
     "serve_p50_ms", "serve_p99_ms", "serve_cold_ms",
     "serve_rejected_total", "serve_requests_total",
+    "serve_trace_coverage", "serve_slowest_ms",
     "live_telemetry",
     "serve_fleet_p50_ms", "serve_fleet_p99_ms", "serve_fleet_replicas",
     "serve_fleet_requests_total", "serve_fleet_rerouted_total",
@@ -49,6 +50,7 @@ SERVE_ROWS = {
     "serve_rejected_total": 0, "serve_requests_total": 24,
     "serve_ok_total": 24, "serve_cancelled_total": 0,
     "serve_error_total": 0,
+    "serve_trace_coverage": 1.0, "serve_slowest_ms": 25.5,
     "live_telemetry": {
         "scrape_url": "http://127.0.0.1:1/metrics", "samples": 3,
         "scrape_errors": 0,
@@ -223,11 +225,15 @@ class TestBenchArtifactSchema:
         assert result["serve_cold_ms"] == 800.0
         assert result["serve_rejected_total"] == 0
         assert result["serve_requests_total"] == 24
+        assert result["serve_trace_coverage"] == 1.0
+        assert result["serve_slowest_ms"] == 25.5
         with telemetry.use(MetricsRegistry()) as reg:
             _, result = _assemble(reg, serve=None)
         assert result["serve_p50_ms"] is None
         assert result["serve_p99_ms"] is None
         assert result["serve_rejected_total"] is None
+        assert result["serve_trace_coverage"] is None
+        assert result["serve_slowest_ms"] is None
         assert result["live_telemetry"] is None
 
     def test_fleet_rows_flow_through(self):

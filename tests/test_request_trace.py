@@ -1,0 +1,911 @@
+"""End-to-end request tracing (ISSUE 14): fleet-wide span propagation,
+tail-latency attribution, and slow-request forensics.
+
+Acceptance pins:
+
+- every span recorded under ``tracing.push(request_id=...)`` carries
+  the id, and the serving path records a full non-overlapping phase
+  breakdown (admission_wait / queue_wait / resume / solve / dump on a
+  replica, + failover / forward / relay through the router) whose sum
+  attributes >=95% of the server-side wall time;
+- both router and replica write one ``request_log.jsonl`` wide event
+  per admitted request; ``tools/trace_report.py`` ranks the slowest,
+  resolves p99 to a concrete request id, and flags unattributed wall
+  time;
+- ``aggregate.stitch_traces(request_id=...)`` stitches ONE request's
+  cross-process waterfall with flow events across the forward/relay
+  hops;
+- chaos: a 3-replica fleet behind kafka-route, the tile0 owner
+  SIGKILLed mid-request — the stitched per-request trace contains
+  router, victim and survivor tracks with a ``route_failover`` span,
+  and trace_report attributes the added tail latency to the failover
+  phase;
+- the ``kafka_engine_device_reads_total == dispatches`` invariant is
+  unchanged with request tracing active.
+
+All tier-1 / CPU.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kafka_tpu import telemetry
+from kafka_tpu.serve import (
+    AssimilationService,
+    HashRing,
+    ServeDaemon,
+    TileRouter,
+    TileSession,
+    make_synthetic_tile,
+    read_response,
+    submit_request,
+    synthetic_dates,
+)
+from kafka_tpu.serve.synthetic import DEFAULT_BASE_DATE
+from kafka_tpu.telemetry import MetricsRegistry, request_log, tracing
+from kafka_tpu.telemetry.aggregate import stitch_traces
+from kafka_tpu.telemetry.httpd import TelemetryHTTPd
+from kafka_tpu.telemetry.tracing import trace_span
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DATES = synthetic_dates(DEFAULT_BASE_DATE, 16, 2)
+
+
+class StubSession:
+    """Duck-typed session reporting honest phase timings."""
+
+    def __init__(self, name, solve_s=0.02, fail=None):
+        self.name = name
+        self.solve_s = solve_s
+        self.fail = fail
+        self.serves = 0
+
+    def serve(self, date):
+        self.serves += 1
+        if self.fail is not None:
+            raise self.fail
+        t0 = time.perf_counter()
+        time.sleep(self.solve_s)
+        return {
+            "status": "ok", "tile": self.name,
+            "date": date.isoformat(), "served_from": "warm",
+            "x_sha256": f"stub-{self.name}",
+            "trace_phases": {
+                "resume_ms": 0.0,
+                "solve_ms": (time.perf_counter() - t0) * 1e3,
+            },
+        }
+
+
+def wait_response(root, rid, timeout_s=60.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        got = read_response(root, rid)
+        if got is not None:
+            return got
+        time.sleep(0.01)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# trace context: request_id rides every span
+# ---------------------------------------------------------------------------
+
+class TestRequestContext:
+    def test_spans_under_push_carry_request_id(self):
+        with telemetry.use(MetricsRegistry()) as reg:
+            with tracing.push(run_id="r", request_id="rq1"):
+                with trace_span("outer"):
+                    with trace_span("inner"):
+                        pass
+            with trace_span("unrelated"):
+                pass
+            events = reg.trace.to_chrome()["traceEvents"]
+        spans = {e["name"]: e for e in events if e.get("ph") == "X"}
+        assert spans["outer"]["args"]["request_id"] == "rq1"
+        assert spans["inner"]["args"]["request_id"] == "rq1"
+        assert "request_id" not in spans["unrelated"]["args"]
+
+    def test_push_overrides_only_given_fields(self):
+        with tracing.push(run_id="r", chunk_id="c"):
+            with tracing.push(request_id="rq2") as ctx:
+                assert ctx.run_id == "r"
+                assert ctx.chunk_id == "c"
+                assert ctx.request_id == "rq2"
+
+
+# ---------------------------------------------------------------------------
+# request_log: wide events, ring, rotation, read side
+# ---------------------------------------------------------------------------
+
+class TestRequestLog:
+    def test_record_lands_in_file_ring_and_counter(self, tmp_path):
+        with telemetry.use(MetricsRegistry(str(tmp_path))) as reg:
+            rec = request_log.record(request_log.build_record(
+                "serve", "rqA", status="ok", e2e_ms=12.5,
+                phases={"solve_ms": 12.0}, tile="t",
+                served_from="warm",
+            ))
+            assert rec["e2e_ms"] == 12.5
+            records, torn = request_log.load_records(str(tmp_path))
+            assert torn == 0
+            assert [r["request_id"] for r in records] == ["rqA"]
+            assert reg.value("kafka_request_log_records_total",
+                             role="serve") == 1
+            view = request_log.requestz(8)
+            assert view["recent"][0]["request_id"] == "rqA"
+            assert view["inflight"] == []
+
+    def test_inflight_note_and_clear_on_record(self):
+        with telemetry.use(MetricsRegistry()):
+            request_log.note_inflight("rqB", tile="t", stage="queued")
+            request_log.note_inflight("rqB", stage="solving")
+            view = request_log.requestz(8)
+            assert view["inflight"][0]["stage"] == "solving"
+            request_log.record(request_log.build_record(
+                "serve", "rqB", status="ok", e2e_ms=1.0,
+            ))
+            assert request_log.requestz(8)["inflight"] == []
+
+    def test_rotation_bounds_the_log(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(request_log, "ROTATE_BYTES", 400)
+        with telemetry.use(MetricsRegistry(str(tmp_path))):
+            for i in range(40):
+                request_log.record(request_log.build_record(
+                    "serve", f"rq{i:03d}", status="ok", e2e_ms=1.0,
+                    phases={"solve_ms": 1.0}, tile="t" * 10,
+                ))
+        names = sorted(n for n in os.listdir(tmp_path)
+                       if n.startswith(request_log.LOG_FILENAME))
+        assert f"{request_log.LOG_FILENAME}.1" in names
+        # keep-N enforced.
+        assert f"{request_log.LOG_FILENAME}." \
+               f"{request_log.KEEP_SEGMENTS + 1}" not in names
+        # ...and the read side walks the segments oldest-first: order
+        # is preserved across rotation boundaries for surviving rows.
+        records, _ = request_log.load_records(str(tmp_path))
+        ids = [r["request_id"] for r in records]
+        assert ids == sorted(ids)
+
+    def test_torn_tail_skipped(self, tmp_path):
+        path = tmp_path / request_log.LOG_FILENAME
+        path.write_text(
+            json.dumps({"request_id": "ok1", "e2e_ms": 5,
+                        "phases": {"solve_ms": 5}}) + "\n"
+            + '{"request_id": "torn'
+        )
+        records, torn = request_log.load_records(str(tmp_path))
+        assert [r["request_id"] for r in records] == ["ok1"]
+        assert torn == 1
+
+    def test_attributed_fraction(self):
+        assert request_log.attributed_fraction(
+            {"e2e_ms": 100.0, "phases": {"a_ms": 60.0, "b_ms": 39.0}}
+        ) == pytest.approx(0.99)
+        assert request_log.attributed_fraction(
+            {"e2e_ms": 0.0, "phases": {"a_ms": 1.0}}) is None
+        assert request_log.attributed_fraction({"phases": {}}) is None
+
+    def test_is_covered_fraction_bar_and_noise_floor(self):
+        # >=95% attributed: covered.
+        assert request_log.is_covered(
+            {"e2e_ms": 100.0, "phases": {"a_ms": 96.0}}) is True
+        # 50% attributed with a 50 ms hole: a finding.
+        assert request_log.is_covered(
+            {"e2e_ms": 100.0, "phases": {"a_ms": 50.0}}) is False
+        # A sub-ms cache hit with microseconds of glue: the fraction
+        # fails but the absolute remainder is noise, not latency.
+        assert request_log.is_covered(
+            {"e2e_ms": 0.7, "phases": {"a_ms": 0.65}}) is True
+        # No usable timing: unknown.
+        assert request_log.is_covered({"phases": {}}) is None
+
+
+# ---------------------------------------------------------------------------
+# service: the replica-side waterfall
+# ---------------------------------------------------------------------------
+
+class TestServiceTrace:
+    def test_ok_response_carries_full_attribution(self, tmp_path):
+        with telemetry.use(MetricsRegistry(str(tmp_path / "tel"))) as reg:
+            svc = AssimilationService(
+                {"t": StubSession("t", solve_s=0.05)}, str(tmp_path),
+            ).start()
+            try:
+                svc.submit({"tile": "t", "date": "2017-07-05",
+                            "request_id": "rq1"})
+                got = svc.result("rq1", timeout_s=30)
+            finally:
+                svc.close()
+            trace = got["trace"]
+            assert trace["request_id"] == "rq1"
+            for key in ("admission_wait_ms", "queue_wait_ms",
+                        "resume_ms", "solve_ms", "dump_ms"):
+                assert key in trace["phases"], key
+            assert trace["e2e_ms"] > 0
+            # The named phases explain >=95% of the server-side wall.
+            assert request_log.attributed_fraction(trace) >= 0.95
+            # The journal entry carries the admission stamp (trace
+            # continuation across replay).
+            with open(svc.journal.journal_path) as f:
+                entry = json.loads(f.readline())
+            assert entry["request_id"] == "rq1"
+            assert entry["admitted_ts"] == pytest.approx(
+                trace["admitted_ts"])
+            # The wide event matches the response's attribution.
+            records, _ = request_log.load_records(
+                str(tmp_path / "tel"))
+            rec = [r for r in records if r["request_id"] == "rq1"][0]
+            assert rec["role"] == "serve"
+            assert rec["status"] == "ok"
+            assert rec["served_from"] == "warm"
+            assert rec["phases"] == trace["phases"]
+            # ...and the waterfall spans carry the request id.
+            spans = [e for e in reg.trace.to_chrome()["traceEvents"]
+                     if e.get("ph") == "X"
+                     and e["args"].get("request_id") == "rq1"]
+            names = {e["name"] for e in spans}
+            assert {"serve_admit", "queue_wait"} <= names
+
+    def test_error_and_cancelled_requests_get_rows(self, tmp_path):
+        with telemetry.use(MetricsRegistry(str(tmp_path / "tel"))):
+            svc = AssimilationService(
+                {"bad": StubSession("bad", fail=ValueError("boom")),
+                 "ok": StubSession("ok", solve_s=0.2)},
+                str(tmp_path),
+            ).start()
+            try:
+                # Queue a slow request, then one with an already-tiny
+                # deadline behind it (cancelled at dequeue), then the
+                # poison one.
+                svc.submit({"tile": "ok", "date": "2017-07-05",
+                            "request_id": "slow"})
+                svc.submit({"tile": "ok", "date": "2017-07-07",
+                            "request_id": "late", "deadline_s": 0.01})
+                svc.submit({"tile": "bad", "date": "2017-07-05",
+                            "request_id": "err"})
+                for rid in ("slow", "late", "err"):
+                    assert svc.result(rid, timeout_s=30) is not None
+            finally:
+                svc.close()
+            records, _ = request_log.load_records(
+                str(tmp_path / "tel"))
+            by_id = {r["request_id"]: r for r in records}
+            assert by_id["slow"]["status"] == "ok"
+            assert by_id["late"]["status"] == "cancelled"
+            assert by_id["err"]["status"] == "error"
+            # Every admitted request has a row with wait attribution.
+            for rid in ("late", "err"):
+                assert "admission_wait_ms" in by_id[rid]["phases"]
+                assert by_id[rid]["e2e_ms"] is not None
+
+    def test_cache_hit_served_and_recorded(self, tmp_path):
+        with telemetry.use(MetricsRegistry(str(tmp_path / "tel"))):
+            svc = AssimilationService(
+                {"t": StubSession("t")}, str(tmp_path),
+            ).start()
+            try:
+                svc.submit({"tile": "t", "date": "2017-07-05",
+                            "request_id": "c1"})
+                assert svc.result("c1", timeout_s=30)["status"] == "ok"
+                svc.submit({"tile": "t", "date": "2017-07-05",
+                            "request_id": "c2"})
+                got = svc.result("c2", timeout_s=30)
+            finally:
+                svc.close()
+            assert got["served_from"] == "cache"
+            assert got["trace"]["request_id"] == "c2"
+            records, _ = request_log.load_records(
+                str(tmp_path / "tel"))
+            rec = [r for r in records if r["request_id"] == "c2"][0]
+            assert rec["served_from"] == "cache"
+
+    def test_replay_continues_trace_with_replayed_span(self, tmp_path):
+        """Satellite 1: a journal-replayed request keeps its id (the
+        trace continues) and shows a visible `replayed` span — not a
+        fresh waterfall."""
+        with telemetry.use(MetricsRegistry(str(tmp_path / "tel"))) as reg:
+            svc = AssimilationService(
+                {"t": StubSession("t")}, str(tmp_path),
+            )
+            # A journaled-but-unanswered request (the crash leftover).
+            svc.journal.record({
+                "request_id": "rep1", "tile": "t",
+                "date": "2017-07-05", "deadline_s": None,
+                "submitted_ts": time.time() - 5.0,
+                "admitted_ts": time.time() - 5.0,
+            })
+            svc.start()
+            try:
+                got = svc.result("rep1", timeout_s=30)
+            finally:
+                svc.close()
+            assert got["status"] == "ok"
+            assert got["trace"]["request_id"] == "rep1"
+            assert got["trace"]["replayed"] is True
+            spans = [e for e in reg.trace.to_chrome()["traceEvents"]
+                     if e.get("ph") == "X" and e["name"] == "replayed"]
+            assert spans and \
+                spans[0]["args"]["request_id"] == "rep1"
+            records, _ = request_log.load_records(
+                str(tmp_path / "tel"))
+            rec = [r for r in records if r["request_id"] == "rep1"][0]
+            assert rec["replayed"] is True
+
+
+# ---------------------------------------------------------------------------
+# session phases + the device-reads invariant under tracing
+# ---------------------------------------------------------------------------
+
+class TestSessionPhases:
+    def test_serve_reports_resume_solve_dump(self, tmp_path):
+        with telemetry.use(MetricsRegistry()):
+            sess = TileSession(make_synthetic_tile(
+                "t", str(tmp_path / "ckpt"), seed=0))
+            body = sess.serve(DATES[-1])
+        phases = body["trace_phases"]
+        assert set(phases) == {"resume_ms", "solve_ms", "dump_ms"}
+        assert phases["solve_ms"] > 0
+
+    def test_device_reads_invariant_with_request_tracing(
+            self, tmp_path):
+        """Zero new device->host transfers: serving under a request
+        trace context performs exactly the reads an untraced serve
+        does — the per-request attribution is host-side arithmetic on
+        stamps the path already takes."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            sess = TileSession(make_synthetic_tile(
+                "t", str(tmp_path / "ck_traced"), seed=0))
+            with tracing.push(run_id="r", request_id="rq-dev"):
+                traced = sess.serve(DATES[-1])
+            reads_traced = reg.value(
+                "kafka_engine_device_reads_total")
+        with telemetry.use(MetricsRegistry()) as reg:
+            sess = TileSession(make_synthetic_tile(
+                "t", str(tmp_path / "ck_plain"), seed=0))
+            plain = sess.serve(DATES[-1])
+            reads_plain = reg.value(
+                "kafka_engine_device_reads_total")
+        assert reads_traced == reads_plain
+        assert reads_traced and reads_traced > 0
+        assert traced["x_sha256"] == plain["x_sha256"]
+
+
+# ---------------------------------------------------------------------------
+# /requestz endpoint
+# ---------------------------------------------------------------------------
+
+class TestRequestzEndpoint:
+    def test_json_and_text_views(self):
+        with telemetry.use(MetricsRegistry()) as reg:
+            request_log.record(request_log.build_record(
+                "serve", "rq9", status="ok", e2e_ms=12.5,
+                phases={"solve_ms": 12.0}, tile="t",
+                served_from="warm",
+            ))
+            request_log.note_inflight("rq10", tile="t", stage="queued")
+            httpd = TelemetryHTTPd(port=0, registry=reg,
+                                   role="serve").start()
+            try:
+                with urllib.request.urlopen(
+                        f"{httpd.url}/requestz?json=1",
+                        timeout=5) as resp:
+                    payload = json.loads(resp.read().decode())
+                assert payload["recent"][0]["request_id"] == "rq9"
+                assert payload["inflight"][0]["request_id"] == "rq10"
+                with urllib.request.urlopen(
+                        f"{httpd.url}/requestz", timeout=5) as resp:
+                    text = resp.read().decode()
+                assert "rq9" in text and "INFLIGHT rq10" in text
+                assert "worst=solve_ms" in text
+                # The index page advertises it.
+                with urllib.request.urlopen(
+                        f"{httpd.url}/", timeout=5) as resp:
+                    assert "/requestz" in json.loads(
+                        resp.read().decode())["endpoints"]
+            finally:
+                httpd.close()
+
+    def test_bad_n_is_400(self):
+        with telemetry.use(MetricsRegistry()) as reg:
+            httpd = TelemetryHTTPd(port=0, registry=reg).start()
+            try:
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    urllib.request.urlopen(
+                        f"{httpd.url}/requestz?n=nope", timeout=5)
+                assert exc.value.code == 400
+            finally:
+                httpd.close()
+
+
+# ---------------------------------------------------------------------------
+# per-request stitching with flow events
+# ---------------------------------------------------------------------------
+
+def _fragment(root, sub, epoch, spans):
+    """One per-process trace.json fragment: spans = (name, ts_us, dur,
+    args)."""
+    events = [{"name": "process_name", "ph": "M", "ts": 0.0,
+               "pid": 7, "tid": 0, "args": {"name": "kafka_tpu"}},
+              {"name": "thread_name", "ph": "M", "ts": 0.0,
+               "pid": 7, "tid": 1, "args": {"name": "serve"}}]
+    for name, ts, dur, args in spans:
+        events.append({"name": name, "cat": "span", "ph": "X",
+                       "ts": ts, "dur": dur, "pid": 7, "tid": 1,
+                       "args": args})
+    os.makedirs(os.path.join(root, sub), exist_ok=True)
+    with open(os.path.join(root, sub, "trace.json"), "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms",
+                   "otherData": {"epoch_unix_s": epoch,
+                                 "run_ids": ["r"]}}, f)
+
+
+class TestStitchByRequest:
+    def test_filters_to_one_request_and_adds_flows(self, tmp_path):
+        root = str(tmp_path)
+        _fragment(root, "router", 100.0, [
+            ("route_forward", 0.0, 50.0, {"request_id": "rq1"}),
+            ("route_relay", 5000.0, 30.0, {"request_id": "rq1"}),
+            ("route_forward", 100.0, 10.0, {"request_id": "other"}),
+        ])
+        _fragment(root, "rep0", 100.001, [
+            ("serve_admit", 500.0, 20.0, {"request_id": "rq1"}),
+            ("queue_wait", 600.0, 100.0, {"request_id": "rq1"}),
+            ("serve_solve", 800.0, 2000.0, {"request_id": "rq1"}),
+        ])
+        # A process that never saw rq1 contributes no track.
+        _fragment(root, "rep1", 100.0, [
+            ("serve_admit", 0.0, 5.0, {"request_id": "other"}),
+        ])
+        doc = stitch_traces(root, request_id="rq1")
+        assert doc["otherData"]["request_id_filter"] == "rq1"
+        assert len(doc["otherData"]["sources"]) == 2
+        xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert all(e["args"]["request_id"] == "rq1" for e in xs)
+        assert len(xs) == 5
+        # Two pid tracks, flow arrows across the hops.
+        assert len({e["pid"] for e in xs}) == 2
+        flows = [e for e in doc["traceEvents"]
+                 if e.get("ph") in ("s", "f")]
+        assert flows, "no flow events across the process hops"
+        starts = [e for e in flows if e["ph"] == "s"]
+        ends = [e for e in flows if e["ph"] == "f"]
+        assert len(starts) == len(ends)
+        for s, e in zip(starts, ends):
+            assert s["id"] == e["id"]
+            assert s["pid"] != e["pid"]
+        # Every event is a well-formed Chrome trace event.
+        for e in doc["traceEvents"]:
+            assert "name" in e and "ph" in e and "pid" in e
+
+    def test_no_match_yields_empty_trace(self, tmp_path):
+        _fragment(str(tmp_path), "router", 100.0, [
+            ("route_forward", 0.0, 50.0, {"request_id": "other"}),
+        ])
+        doc = stitch_traces(str(tmp_path), request_id="ghost")
+        assert doc["otherData"]["sources"] == []
+        assert doc["traceEvents"] == []
+
+
+# ---------------------------------------------------------------------------
+# trace_report
+# ---------------------------------------------------------------------------
+
+def _write_log(dirpath, rows):
+    os.makedirs(dirpath, exist_ok=True)
+    with open(os.path.join(dirpath, request_log.LOG_FILENAME),
+              "a") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+
+
+class TestTraceReport:
+    def _seed(self, root):
+        _write_log(os.path.join(root, "router"), [
+            {"ts": 3.0, "role": "route", "request_id": "slow1",
+             "status": "ok", "tile": "tile0", "served_from": "warm",
+             "replica": "rep1", "e2e_ms": 5000.0,
+             "phases": {"admission_wait_ms": 5.0,
+                        "failover_ms": 4200.0, "forward_ms": 10.0,
+                        "queue_wait_ms": 5.0, "resume_ms": 100.0,
+                        "solve_ms": 600.0, "dump_ms": 5.0,
+                        "relay_ms": 50.0},
+             "reroutes": [{"reason": "dead", "replica": "rep0",
+                           "held_ms": 4200.0}]},
+            {"ts": 1.0, "role": "route", "request_id": "fast1",
+             "status": "ok", "tile": "tile1", "served_from": "warm",
+             "replica": "rep0", "e2e_ms": 50.0,
+             "phases": {"admission_wait_ms": 2.0, "forward_ms": 3.0,
+                        "queue_wait_ms": 1.0, "resume_ms": 4.0,
+                        "solve_ms": 38.0, "dump_ms": 1.0,
+                        "relay_ms": 1.0}},
+        ])
+        _write_log(os.path.join(root, "rep1"), [
+            # The replica's own record of slow1: the router's merged
+            # record must win (it has the full e2e).
+            {"ts": 2.5, "role": "serve", "request_id": "slow1",
+             "status": "ok", "tile": "tile0", "served_from": "warm",
+             "e2e_ms": 720.0,
+             "phases": {"queue_wait_ms": 5.0, "resume_ms": 100.0,
+                        "solve_ms": 600.0, "dump_ms": 5.0},
+             "solver_health": {"quarantined": 0}},
+            {"ts": 2.0, "role": "serve", "request_id": "gap1",
+             "status": "ok", "tile": "tile1", "served_from": "warm",
+             "e2e_ms": 100.0, "phases": {"solve_ms": 50.0}},
+        ])
+
+    def test_report_merges_ranks_and_flags(self, tmp_path):
+        from tools.trace_report import build_report
+
+        self._seed(str(tmp_path))
+        report = build_report(str(tmp_path), slowest=5)
+        assert report["requests_total"] == 3
+        assert report["by_status"] == {"ok": 3}
+        slowest = report["slowest"]
+        assert slowest[0]["request_id"] == "slow1"
+        # The router record won the merge and carries the failover
+        # attribution + the replica's solver_health backfill.
+        assert slowest[0]["role"] == "route"
+        assert slowest[0]["phases"]["failover_ms"] == 4200.0
+        assert slowest[0]["solver_health"] == {"quarantined": 0}
+        assert slowest[0]["coverage"] >= 0.99
+        # The unattributed check catches gap1 (50% attributed).
+        assert [u["request_id"] for u in report["unattributed"]] == \
+            ["gap1"]
+        # p99 resolves to a real request id in a real histogram
+        # bucket.
+        p99 = report["exemplars"]["p99"]
+        assert p99["request_id"] == "slow1"
+        assert p99["value_ms"] == 5000.0
+        assert p99["bucket_le_ms"] == 5000.0
+        assert "slow1" in p99["bucket_request_ids"]
+
+    def test_cli_exit_codes_and_json(self, tmp_path, capsys):
+        from tools.trace_report import main
+
+        self._seed(str(tmp_path))
+        assert main([str(tmp_path), "--json"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["requests_total"] == 3
+        # --unattributed is a check: exit 1 while gap1 is below bar.
+        assert main([str(tmp_path), "--unattributed"]) == 1
+        capsys.readouterr()
+        assert main([str(tmp_path), "--unattributed",
+                     "--coverage", "0.4"]) == 0
+        capsys.readouterr()
+        # Single-request detail; unknown id and missing root are usage
+        # errors.
+        assert main([str(tmp_path), "--request", "slow1"]) == 0
+        out = capsys.readouterr().out
+        assert "failover=4200.0ms" in out
+        assert "reroute: rep0 (dead" in out
+        assert main([str(tmp_path), "--request", "nope"]) == 2
+        assert main([str(tmp_path / "missing")]) == 2
+
+    def test_stitch_flag_writes_request_trace(self, tmp_path, capsys):
+        from tools.trace_report import main
+
+        self._seed(str(tmp_path))
+        _fragment(str(tmp_path), "router", 100.0, [
+            ("route_forward", 0.0, 50.0, {"request_id": "slow1"}),
+        ])
+        _fragment(str(tmp_path), "rep1", 100.0, [
+            ("serve_solve", 500.0, 600.0, {"request_id": "slow1"}),
+        ])
+        out_path = str(tmp_path / "req.json")
+        assert main([str(tmp_path), "--request", "slow1",
+                     "--stitch", out_path, "--json"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["stitched_trace"]["path"] == out_path
+        with open(out_path) as f:
+            doc = json.load(f)
+        assert len(doc["otherData"]["sources"]) == 2
+        # --stitch without --request is usage.
+        assert main([str(tmp_path), "--stitch", out_path]) == 2
+
+
+# ---------------------------------------------------------------------------
+# loadgen coverage rows
+# ---------------------------------------------------------------------------
+
+class TestLoadgenCoverage:
+    def test_rows_emitted_from_server_traces(self, tmp_path):
+        from tools.loadgen import _Target, run_load
+
+        with telemetry.use(MetricsRegistry()):
+            svc = AssimilationService(
+                {"t": StubSession("t", solve_s=0.03)}, str(tmp_path),
+            ).start()
+            try:
+                rows = run_load(
+                    _Target(service=svc),
+                    [{"tile": "t", "date": "2017-07-05"}
+                     for _ in range(6)],
+                    concurrency=2, timeout_s=60,
+                )
+            finally:
+                svc.close()
+        assert rows["serve_ok_total"] == 6
+        assert rows["serve_trace_coverage"] == 1.0
+        assert rows["serve_slowest_ms"] > 0
+
+    def test_bench_compare_diffs_informationally(self, tmp_path,
+                                                 capsys):
+        from tools.bench_compare import main as compare
+
+        base = {"serve_trace_coverage": 1.0, "serve_slowest_ms": 40.0}
+        old = tmp_path / "old.json"
+        new = tmp_path / "new.json"
+        old.write_text(json.dumps(base))
+        new.write_text(json.dumps({"serve_trace_coverage": 0.8,
+                                   "serve_slowest_ms": 90.0}))
+        # No gate: exit 0 — but the coverage drop is called out.
+        assert compare([str(old), str(new)]) == 0
+        out = capsys.readouterr().out
+        assert "serve_trace_coverage: 1 -> 0.8" in out
+        assert "WARNING serve_trace_coverage dropped" in out
+        assert "serve_slowest_ms: 40 -> 90" in out
+
+
+# ---------------------------------------------------------------------------
+# fleet_status surfacing
+# ---------------------------------------------------------------------------
+
+class TestFleetStatusRecentRequests:
+    def test_render_shows_recent_requests(self, tmp_path):
+        from tools.fleet_status import build_view, render
+
+        snap = {
+            "schema": 1, "ts": time.time(), "host": "h", "pid": 9,
+            "role": "serve", "seq": 1, "interval_s": 2.0,
+            "final": False, "run_id": None, "chunk_id": None,
+            "health": {"unhealthy": None}, "quality": {}, "perf": {},
+            "counters": {}, "gauges": {}, "histograms": {},
+            "series_truncated": 0, "crash_dumps": [],
+            "status": {"recent_requests": [
+                {"request_id": "rq7", "status": "ok",
+                 "served_from": "warm", "e2e_ms": 42.0},
+            ]},
+        }
+        with open(tmp_path / "live_h_9.json", "w") as f:
+            json.dump(snap, f)
+        text = render(build_view(str(tmp_path), ttl_s=60.0))
+        assert "recent: rq7(ok,warm,42ms)" in text
+
+
+# ---------------------------------------------------------------------------
+# the chaos acceptance: 3-replica fleet, SIGKILL the tile0 owner
+# ---------------------------------------------------------------------------
+
+def _subprocess_env():
+    from kafka_tpu.resilience import faults
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["KAFKA_TPU_LIVE_INTERVAL_S"] = "0.2"
+    env.pop(faults.ENV_VAR, None)
+    return env
+
+
+def _replica_cmd(root, ckpt_root, tel_dir):
+    return [
+        sys.executable, "-m", "kafka_tpu.cli.kafka_serve",
+        "--root", str(root), "--ckpt-root", str(ckpt_root),
+        "--tiles", "2", "--operator", "identity",
+        "--ny", "16", "--nx", "20", "--days", "40", "--step", "2",
+        "--obs-every", "2", "--poll-interval-s", "0.02",
+        "--telemetry-dir", str(tel_dir),
+    ]
+
+
+def _router_cmd(front, replicas, fleet_dir, tel_dir):
+    spec = ",".join(f"{rid}={root}" for rid, root in replicas.items())
+    return [
+        sys.executable, "-m", "kafka_tpu.cli.kafka_route",
+        "--root", str(front), "--replicas", spec,
+        "--fleet-dir", str(fleet_dir), "--ttl-s", "1.0",
+        "--refresh-s", "0.2", "--poll-interval-s", "0.02",
+        "--telemetry-dir", str(tel_dir),
+    ]
+
+
+def _trace_has_request(path, rid):
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return False
+    return any(
+        (e.get("args") or {}).get("request_id") == rid
+        for e in doc.get("traceEvents") or ()
+    )
+
+
+class TestRequestTracingChaosAcceptance:
+    def test_failover_trace_attributes_tail_latency(self, tmp_path):
+        """ISSUE 14 acceptance: loadgen against a 3-replica fleet
+        behind kafka-route with one SIGKILL mid-request.  Every
+        admitted request leaves a request_log row and a stitchable
+        per-request trace; the victim request's stitched waterfall
+        contains router, victim and survivor tracks with a
+        route_failover span; trace_report attributes >=95% of the
+        slowest request's wall time to named phases with failover
+        dominating; the p99 exemplar resolves to a real request id
+        whose stitched trace is a well-formed Chrome trace with >=2
+        process tracks."""
+        from tools.loadgen import _Target, run_load
+        from tools.trace_report import build_report
+
+        env = _subprocess_env()
+        tel = tmp_path / "tel"
+        ckpt = tmp_path / "ckpt"
+        front = str(tmp_path / "front")
+        dates = synthetic_dates(DEFAULT_BASE_DATE, 40, 2)
+        date = dates[-1]
+
+        replicas = {f"rep{i}": str(tmp_path / f"rep{i}")
+                    for i in range(3)}
+        victim_rid = HashRing(replicas).owner("tile0")
+        procs = {}
+        router_proc = None
+        try:
+            for rid, root in replicas.items():
+                procs[rid] = subprocess.Popen(
+                    _replica_cmd(root, ckpt, tel / rid), env=env,
+                    cwd=REPO_ROOT, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                )
+            router_proc = subprocess.Popen(
+                _router_cmd(front, replicas, tel, tel / "router"),
+                env=env, cwd=REPO_ROOT,
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True,
+            )
+            victim = procs[victim_rid]
+
+            # Wait for the router's first heartbeat before submitting:
+            # the victim request's admission_wait must measure inbox
+            # wait, not router process boot — failover must be the
+            # dominant phase of its breakdown.
+            router_tel = tel / "router"
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                if router_tel.is_dir() and any(
+                        n.startswith("live_")
+                        for n in os.listdir(router_tel)):
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail("router never published a heartbeat")
+
+            rid = submit_request(front, {
+                "tile": "tile0", "date": date.isoformat(),
+                "request_id": "victimreq",
+            })
+            # Kill the owner once (a) it admitted the request
+            # (journal), (b) warm state exists (shared checkpoints),
+            # and (c) its live-published trace fragment carries the
+            # request — the victim track the stitched waterfall needs.
+            victim_journal = tmp_path / victim_rid / "requests.jsonl"
+            victim_trace = tel / victim_rid / "trace.json"
+            ck_dir = ckpt / "ckpt_tile0"
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                if victim.poll() is not None:
+                    pytest.fail(
+                        f"victim exited rc={victim.returncode} before "
+                        "it could be killed"
+                    )
+                if read_response(front, rid) is not None:
+                    pytest.fail("fleet answered before the kill — "
+                                "widen the request")
+                journal_text = victim_journal.read_text() \
+                    if victim_journal.exists() else ""
+                if rid in journal_text and ck_dir.is_dir() and any(
+                        n.endswith(".npz")
+                        for n in os.listdir(ck_dir)) and \
+                        _trace_has_request(victim_trace, rid):
+                    break
+                time.sleep(0.005)
+            else:
+                pytest.fail("victim never admitted + checkpointed + "
+                            "published its trace")
+            victim.kill()
+            victim.wait(timeout=30)
+
+            got = wait_response(front, rid, timeout_s=300)
+            assert got is not None, "re-routed request was lost"
+            assert got["status"] == "ok"
+            assert got["replica"] != victim_rid
+            # The relayed response carries the merged attribution with
+            # the failover hop on record.
+            trace = got["trace"]
+            assert trace["request_id"] == rid
+            assert trace["phases"]["failover_ms"] > 0
+            assert trace["reroutes"][0]["replica"] == victim_rid
+            assert trace["reroutes"][0]["reason"] == "dead"
+
+            # Post-failover load: every request lands, and every
+            # server trace attributes >=95% of its wall time.
+            plan = [{"tile": f"tile{i % 2}",
+                     "date": dates[-1 - (i % 2)].isoformat()}
+                    for i in range(6)]
+            rows = run_load(_Target(root=front), plan, concurrency=3,
+                            timeout_s=300, backoff_budget=8)
+            assert rows["serve_ok_total"] == 6
+            assert rows["serve_trace_coverage"] == 1.0
+            assert rows["serve_slowest_ms"] > 0
+
+            # Clean shutdown so every process dumps its full trace.
+            router_proc.send_signal(signal.SIGTERM)
+            out, _ = router_proc.communicate(timeout=120)
+            assert router_proc.returncode == 0
+            for rep_rid, proc in procs.items():
+                if rep_rid != victim_rid:
+                    proc.send_signal(signal.SIGTERM)
+            for rep_rid, proc in procs.items():
+                if rep_rid != victim_rid:
+                    assert proc.wait(timeout=120) == 0
+
+            # 100% of admitted requests have a router wide event.
+            records, torn = request_log.load_records(str(tel))
+            assert torn == 0
+            route_rows = {r["request_id"]: r for r in records
+                          if r["role"] == "route"}
+            assert len(route_rows) == 7  # victimreq + 6 loadgen
+            assert all(r["status"] == "ok"
+                       for r in route_rows.values())
+
+            # trace_report: the slowest request IS the victim, >=95%
+            # attributed, failover the dominant phase.
+            report = build_report(str(tel), slowest=10)
+            slowest = report["slowest"][0]
+            assert slowest["request_id"] == rid
+            assert slowest["coverage"] >= 0.95
+            phases = slowest["phases"]
+            assert phases["failover_ms"] == max(phases.values())
+            assert report["unattributed"] == []
+            assert report["coverage_ok_fraction"] == 1.0
+
+            # The p99 exemplar resolves to a real request whose
+            # stitched trace is a well-formed Chrome trace with >=2
+            # process tracks.
+            p99 = report["exemplars"]["p99"]
+            assert p99["request_id"] in route_rows
+            doc = stitch_traces(str(tel),
+                                request_id=p99["request_id"])
+            assert len(doc["otherData"]["sources"]) >= 2
+            for e in doc["traceEvents"]:
+                assert "name" in e and "ph" in e and "pid" in e
+
+            # The victim request's waterfall: router + victim +
+            # survivor tracks, with the route_failover span.
+            doc = stitch_traces(str(tel), request_id=rid)
+            src_dirs = {os.path.dirname(s["path"])
+                        for s in doc["otherData"]["sources"]}
+            assert "router" in src_dirs
+            assert victim_rid in src_dirs, (
+                "victim track missing — live trace persistence "
+                f"failed (sources: {sorted(src_dirs)})"
+            )
+            assert len(src_dirs) >= 3
+            span_names = {e["name"] for e in doc["traceEvents"]
+                          if e.get("ph") == "X"}
+            assert "route_failover" in span_names
+            assert "route_forward" in span_names
+            flows = [e for e in doc["traceEvents"]
+                     if e.get("ph") in ("s", "f")]
+            assert flows, "no flow events across the failover hops"
+        finally:
+            for proc in list(procs.values()) + [router_proc]:
+                if proc is not None and proc.poll() is None:
+                    proc.kill()

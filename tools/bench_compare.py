@@ -232,6 +232,33 @@ def live_telemetry_deltas(old: dict, new: dict) -> List[str]:
     return lines
 
 
+def trace_coverage_deltas(old: dict, new: dict) -> List[str]:
+    """Informational diff of the request-tracing rows (ISSUE 14):
+    ``serve_trace_coverage`` (fraction of requests whose per-request
+    trace attributes >=95% of wall time) and ``serve_slowest_ms`` (the
+    worst single request).  NOT gated yet — the rows establish the
+    trend first; a coverage drop is called out loudly because it means
+    the tracing itself regressed (latency became unexplainable), which
+    is an observability break, not a perf question."""
+    lines: List[str] = []
+    for key in ("serve_trace_coverage", "serve_slowest_ms"):
+        a, b = old.get(key), new.get(key)
+        if a is None and b is None:
+            continue
+        fmt = (lambda v: "-" if v is None else f"{v:g}")
+        lines.append(f"  {key}: {fmt(a)} -> {fmt(b)}")
+    a, b = old.get("serve_trace_coverage"), \
+        new.get("serve_trace_coverage")
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)) \
+            and b < a:
+        lines.append(
+            f"  WARNING serve_trace_coverage dropped {a:g} -> {b:g}: "
+            "requests with unexplained latency appeared (run "
+            "tools/trace_report.py --unattributed on the new run)"
+        )
+    return lines
+
+
 def telemetry_deltas(old: dict, new: dict, top: int = 8) -> List[str]:
     """Largest relative changes between the embedded registry snapshots
     (context for a timing shift; never gated on)."""
@@ -316,6 +343,12 @@ def main(argv=None) -> int:
     if live_lines:
         print("live telemetry deltas (mid-run scrape, not gated):")
         for line in live_lines:
+            print(line)
+    trace_lines = trace_coverage_deltas(old, new)
+    if trace_lines:
+        print("request-tracing deltas (attribution coverage, "
+              "not gated):")
+        for line in trace_lines:
             print(line)
     health_warnings, health_lines = solver_health_deltas(old, new)
     if health_lines:

@@ -62,6 +62,23 @@ def render(fleet: dict) -> str:
             f"  {w['key']} [{w['role']}] {state}  "
             f"heartbeat {w['age_s']:.1f}s ago{extra}"
         )
+        # Per-request view (ISSUE 14): the compact recent_requests
+        # status fact both kafka-serve and kafka-route publish — the
+        # fleet-level echo of their /requestz endpoints.
+        recent = (w.get("status") or {}).get("recent_requests") or ()
+        if recent:
+            shown = ", ".join(
+                f"{r.get('request_id')}"
+                f"({r.get('status')}"
+                + (f",{r['served_from']}" if r.get("served_from")
+                   else "")
+                + (f",{r['e2e_ms']:.0f}ms"
+                   if isinstance(r.get("e2e_ms"), (int, float))
+                   else "")
+                + ")"
+                for r in recent[-3:]
+            )
+            lines.append(f"    recent: {shown}")
     if fleet["dead_hosts"]:
         lines.append(f"dead hosts: {', '.join(fleet['dead_hosts'])}")
     lines.extend(_render_routers(fleet))
@@ -195,6 +212,10 @@ def main(argv=None) -> int:
     ap.add_argument("--run-id", default=None,
                     help="only stitch trace fragments carrying this "
                          "run id")
+    ap.add_argument("--request-id", default=None,
+                    help="with --stitch-trace: stitch ONE request's "
+                         "cross-process waterfall (router + replica "
+                         "tracks, flow arrows across the hops)")
     ap.add_argument("--watch", type=float, default=None,
                     metavar="SECONDS",
                     help="live dashboard mode: clear the screen and "
@@ -238,7 +259,8 @@ def _render_once(args) -> int:
     if args.stitch_trace:
         from kafka_tpu.telemetry.aggregate import stitch_traces
 
-        doc = stitch_traces(args.root, run_id=args.run_id)
+        doc = stitch_traces(args.root, run_id=args.run_id,
+                            request_id=args.request_id)
         with open(args.stitch_trace, "w") as f:
             json.dump(doc, f)
         fleet["stitched_trace"] = {

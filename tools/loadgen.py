@@ -233,16 +233,29 @@ def run_load(
                     with lock:
                         backoff_total[0] += backoffs
                         results.append(
-                            ("rejected", rejected.get("reason"), 0.0)
+                            ("rejected", rejected.get("reason"),
+                             0.0, None, None)
                         )
                     break
                 wall_ms = (time.perf_counter() - t0) * 1e3
                 status = "timeout" if got is None \
                     else got.get("status", "?")
                 health = (got or {}).get("solver_health") or {}
+                # Per-request tracing attribution (ISSUE 14): the
+                # server's trace block carries the named phases and
+                # the server-side e2e — covered means the named spans
+                # explain the request's wall time (request_log's
+                # fraction bar with the absolute noise floor).
+                from kafka_tpu.telemetry import request_log
+
+                trace = (got or {}).get("trace") or {}
+                server_ms = trace.get("e2e_ms")
+                covered = request_log.is_covered(trace)
                 with lock:
                     backoff_total[0] += backoffs
-                    results.append((status, None, wall_ms))
+                    results.append(
+                        (status, None, wall_ms, covered, server_ms)
+                    )
                     for key, v in health.items():
                         health_totals[key] = \
                             health_totals.get(key, 0) + int(v or 0)
@@ -261,10 +274,23 @@ def run_load(
     for t in threads:
         t.join()
     wall_s = time.perf_counter() - t_start
-    ok_lat = [w for s, _, w in results if s == "ok"]
+    ok_lat = [w for s, _, w, _, _ in results if s == "ok"]
     p50, p99 = _percentiles(ok_lat)
-    count = lambda s: sum(1 for st, _, _ in results if st == s)
+    count = lambda s: sum(1 for st, _, _, _, _ in results if st == s)
     n_ok = count("ok")
+    # Tracing-coverage rows (ISSUE 14): the fraction of OK requests
+    # whose named spans explain their server-side wall time, and the
+    # slowest single request — the exemplar tools/trace_report.py
+    # breaks down.
+    covs = [c for s, _, _, c, _ in results if s == "ok" and
+            c is not None]
+    trace_coverage = (
+        round(sum(1 for c in covs if c) / len(covs), 4)
+        if covs else None
+    )
+    slowest = [sm if sm is not None else w
+               for s, _, w, _, sm in results if s == "ok"]
+    slowest_ms = round(max(slowest), 3) if slowest else None
     return {
         "serve_p50_ms": p50,
         "serve_p99_ms": p99,
@@ -278,6 +304,12 @@ def run_load(
         # Backoff waits taken on retry_after_s rejection hints — the
         # client-side view of admission shedding under load.
         "serve_backoff_total": backoff_total[0],
+        # Request-tracing rows (BASELINE.md "Request tracing"): how
+        # much of the served latency the per-request traces explain,
+        # and the single worst request (server-side e2e) — diffed
+        # informationally by tools/bench_compare.py.
+        "serve_trace_coverage": trace_coverage,
+        "serve_slowest_ms": slowest_ms,
         # Result QUALITY rows, summed over answered requests from the
         # per-response solver_health blocks: latency numbers alone would
         # hide a service answering fast with quarantined pixels.
@@ -468,6 +500,8 @@ def bench_fleet(
             "serve_fleet_replicas": len(replica_roots),
             "serve_fleet_cold_ms": cold_ms,
             "serve_backoff_total": rows["serve_backoff_total"],
+            "serve_trace_coverage": rows["serve_trace_coverage"],
+            "serve_slowest_ms": rows["serve_slowest_ms"],
         }
     finally:
         router.drain()
