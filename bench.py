@@ -459,6 +459,16 @@ def assemble_result(
         # load, diffed informationally by tools/bench_compare.py.
         "live_telemetry": None if serve is None
         else serve.get("live_telemetry"),
+        # SLO rows (tools/loadgen's fast-windowed evaluator over the
+        # serving bench, kafka_tpu.telemetry.slo): alert episodes fired
+        # during the bench and the worst per-objective error-budget
+        # remainder — a bench that got faster by burning its budget
+        # must not read as a clean win (bench_compare warns LOUDLY on
+        # a 0 -> nonzero alert flip).
+        "serve_slo_alerts_total": None if serve is None
+        else serve.get("serve_slo_alerts_total"),
+        "serve_slo_budget_remaining": None if serve is None
+        else serve.get("serve_slo_budget_remaining"),
         # Elastic-fleet serving rows (tools/loadgen.bench_fleet: N
         # in-process replicas behind the consistent-hash router, one
         # client-visible serving surface).  serve_fleet_p50/p99_ms gate
@@ -508,6 +518,12 @@ def assemble_result(
         # utilization lower bound — so the artifact carries the same
         # attribution a dashboard watched during the run.
         "perf": perf_snapshot(reg),
+        # Compact SLO snapshot (BASELINE.md "SLOs & alerting"): alert
+        # counts, firing objectives and the per-objective error-budget
+        # remainder from the registry-bound engine — always present
+        # (the stable disabled shape when no evaluator ran), diffed
+        # informationally by tools/bench_compare.py.
+        "slo": slo_snapshot(reg),
     }
 
 
@@ -521,6 +537,35 @@ def perf_snapshot(registry=None) -> dict:
     return _perf.summary(
         registry if registry is not None else get_registry()
     )
+
+
+def slo_snapshot(registry=None) -> dict:
+    """The run's SLO state as a compact dict: alert counts, firing
+    objectives and the per-objective budget remainder — the stable
+    disabled shape when no evaluator ran on this registry."""
+    from kafka_tpu.telemetry import slo as _slo
+
+    reg = registry if registry is not None else get_registry()
+    summary = _slo.summary(reg)
+    objectives = {
+        name: {
+            "status": o.get("status"),
+            "budget_remaining": (o.get("budget") or {}).get(
+                "remaining"
+            ),
+        }
+        for name, o in (summary.get("objectives") or {}).items()
+    }
+    return {
+        "enabled": bool(summary.get("enabled")),
+        "alerts_fired": int(summary.get("alerts_fired") or 0),
+        "alerts_resolved": int(summary.get("alerts_resolved") or 0),
+        "firing": sorted(
+            f"{a.get('objective')}:{a.get('severity')}"
+            for a in summary.get("firing") or ()
+        ),
+        "objectives": objectives,
+    }
 
 
 def quality_snapshot(registry=None) -> dict:

@@ -114,7 +114,7 @@ def main(argv=None) -> int:
     from ..engine.config import RunConfig
     from ..io.tiling import Chunk
     from ..telemetry import (
-        configure, flight_recorder, get_registry, live,
+        configure, flight_recorder, get_registry, live, slo,
         install_compile_listeners, tracing,
     )
     from .drivers import (
@@ -147,6 +147,9 @@ def main(argv=None) -> int:
     # so this worker's spans and crash dumps correlate with its trace.
     with tracing.push(run_id=tracing.new_run_id(), chunk_id=prefix):
         live.start_publisher(role="chunk_worker")
+        # SLO evaluator (telemetry.slo): solver/quality burn over this
+        # worker's registry, alerts.jsonl next to its chunk telemetry.
+        slo.start_engine()
         try:
             with recorder:
                 summary = run_one_chunk(
@@ -159,6 +162,7 @@ def main(argv=None) -> int:
                 return OOM_EXIT_CODE
             raise
         finally:
+            slo.stop_engine()
             live.stop_publisher()
     get_registry().dump()
     print(json.dumps(summary))

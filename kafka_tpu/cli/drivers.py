@@ -504,7 +504,7 @@ def run_config(
     needs no assignment (BASELINE.md "Multi-host queue")."""
     from ..resilience import RetryPolicy, faults
     from ..telemetry import (
-        configure, flight_recorder, get_registry, live,
+        configure, flight_recorder, get_registry, live, slo,
         install_compile_listeners, tracing,
     )
     from ..utils.compilation_cache import enable_compilation_cache
@@ -559,6 +559,9 @@ def run_config(
         # background for operators watching mid-run (no-op without a
         # telemetry dir; the stop writes the clean-shutdown snapshot).
         live.start_publisher(role="queue_worker" if queue else "engine")
+        # SLO evaluator (telemetry.slo): solver/quality/perf burn over
+        # this run's registry, serving /alertz and alerts.jsonl.
+        slo.start_engine()
         try:
             if queue:
                 from ..shard.queue import DEFAULT_LEASE_TTL_S, run_queue
@@ -588,6 +591,7 @@ def run_config(
                     ),
                 )
         finally:
+            slo.stop_engine()
             live.stop_publisher()
     stats["chunks_with_pixels"] = len(summaries)
     stats["pixels"] = int(

@@ -83,6 +83,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "gauge is at or past this bound")
     ap.add_argument("--retry-after-s", type=float, default=0.5,
                     help="backoff hint on router-level rejections")
+    ap.add_argument("--shed-slo", action="store_true",
+                    help="shed new submissions (reason slo_burn) while "
+                         "any PAGE-severity SLO alert fires on the "
+                         "router's registry (telemetry.slo)")
     ap.add_argument("--poll-interval-s", type=float, default=0.05,
                     help="inbox/response scan cadence")
     ap.add_argument("--exit-when-idle", action="store_true",
@@ -113,7 +117,7 @@ def main(argv=None):
     from ..resilience import faults
     from ..serve.router import RoutePolicy, TileRouter
     from ..telemetry import (
-        configure, flight_recorder, get_registry, live, tracing,
+        configure, flight_recorder, get_registry, live, slo, tracing,
     )
     from ..telemetry.httpd import maybe_start
 
@@ -131,6 +135,7 @@ def main(argv=None):
         ttl_s=args.ttl_s,
         max_queue_depth=args.max_queue_depth,
         retry_after_s=args.retry_after_s,
+        shed_on_slo=args.shed_slo,
     )
     router = TileRouter(
         replicas, args.root,
@@ -146,12 +151,17 @@ def main(argv=None):
         live.update_status(router_root=os.path.abspath(args.root))
         live.start_publisher(role="route",
                              interval_s=args.live_interval_s)
+        # SLO evaluator over the router's registry: availability here
+        # means the whole fleet behind the front door (the router's
+        # latency/rejection counters are client-visible totals).
+        slo.start_engine()
         httpd = maybe_start(args.http_port,
                             status_provider=router.status,
                             role="route")
         try:
             summary = router.run()
         finally:
+            slo.stop_engine()
             live.stop_publisher()
             if httpd is not None:
                 httpd.close()

@@ -126,6 +126,20 @@ def build_parser() -> argparse.ArgumentParser:
                          "(kafka_quality_drift_active > 0); default "
                          "serves degraded answers labelled via the "
                          "response's quality field")
+    ap.add_argument("--shed-slo", action="store_true",
+                    help="shed requests (reason slo_burn) while any "
+                         "PAGE-severity SLO alert is firing "
+                         "(kafka_slo_alerts_firing, telemetry.slo); "
+                         "default keeps admitting and lets the alert "
+                         "page the operator")
+    ap.add_argument("--slo-fast-window-s", type=float, default=None,
+                    help="SLO fast (paging) burn-rate window "
+                         "(default 300s)")
+    ap.add_argument("--slo-slow-window-s", type=float, default=None,
+                    help="SLO slow (warning) burn-rate window "
+                         "(default 3600s)")
+    ap.add_argument("--slo-interval-s", type=float, default=None,
+                    help="SLO evaluation cadence (default 5s)")
     add_telemetry_arg(ap)
     ap.add_argument("--verbose", action="store_true")
     return ap
@@ -185,6 +199,7 @@ def main(argv=None):
         shed_when_unhealthy=not args.no_shed_unhealthy,
         max_dead_hosts=args.max_dead_hosts,
         shed_on_quality_drift=args.shed_quality_drift,
+        shed_on_slo=args.shed_slo,
     )
     service = AssimilationService(
         sessions, args.root, policy=policy,
@@ -217,10 +232,17 @@ def main(argv=None):
             "fleet_dir": args.fleet_dir,
         }
 
-    from ..telemetry import live
+    from ..telemetry import live, slo
     from ..telemetry.httpd import maybe_start
 
     reg = get_registry()
+    slo_kwargs = {
+        k: v for k, v in (
+            ("fast_window_s", args.slo_fast_window_s),
+            ("slow_window_s", args.slo_slow_window_s),
+            ("interval_s", args.slo_interval_s),
+        ) if v is not None
+    }
     with tracing.push(run_id=tracing.new_run_id()), recorder:
         # Fleet plane: heartbeat snapshots + the optional live HTTP
         # endpoint, up for exactly as long as the daemon serves.
@@ -228,11 +250,16 @@ def main(argv=None):
                            tiles=sorted(sessions))
         live.start_publisher(role="serve",
                              interval_s=args.live_interval_s)
+        # SLO evaluator (telemetry.slo): burn-rate alerting over the
+        # daemon's own registry, serving /alertz and the slo_burn
+        # shed signal for exactly as long as the daemon serves.
+        slo.start_engine(**slo_kwargs)
         httpd = maybe_start(args.http_port, status_provider=statusz,
                             role="serve")
         try:
             summary = daemon.run()
         finally:
+            slo.stop_engine()
             live.stop_publisher()
             if httpd is not None:
                 httpd.close()

@@ -176,7 +176,7 @@ def main(argv=None):
         # the one shared filesystem queue.
         return _run_fleet(args, raw_argv)
     from ..telemetry import (
-        configure, flight_recorder, get_registry, live, perf,
+        configure, flight_recorder, get_registry, live, perf, slo,
         install_compile_listeners, tracing,
     )
     from ..telemetry.httpd import maybe_start
@@ -238,6 +238,9 @@ def main(argv=None):
         live.start_publisher(
             role="queue_worker" if args.queue else "engine"
         )
+        # SLO evaluator (telemetry.slo): solver/quality/perf burn over
+        # this run's registry, serving /alertz and alerts.jsonl.
+        slo.start_engine()
         try:
             if args.chunk_size > 0:
                 summary = _run_chunked(
@@ -251,6 +254,7 @@ def main(argv=None):
                 )
         finally:
             perf.stop_windowed_capture()
+            slo.stop_engine()
             live.stop_publisher()
             if httpd is not None:
                 httpd.close()

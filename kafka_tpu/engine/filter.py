@@ -534,6 +534,11 @@ class KalmanFilter:
             "kafka_engine_windows_total",
             "assimilated observation windows",
         ).inc(mode="fused" if "fused" in rec else "single")
+        reg.counter(
+            "kafka_engine_pixels_total",
+            "valid pixels assimilated, summed over windows — the "
+            "solver SLO objective's denominator (telemetry.slo)",
+        ).inc(self.gather.n_valid)
         reg.histogram(
             "kafka_engine_gn_iterations",
             "Gauss-Newton iterations to convergence per window",

@@ -38,6 +38,12 @@ Prometheus export read:
                                 operator may prefer explicit rejection
                                 (reason ``quality_degraded``) over
                                 quietly shipping them
+``kafka_slo_alerts_firing``     PAGE-severity SLO alerts currently
+                                firing (``telemetry.slo`` burn-rate
+                                rules) — a service burning its error
+                                budget catastrophically can shed
+                                (reason ``slo_burn``) to stop the
+                                burn at the front door
 =============================== =====================================
 
 Every decision is explicit: admitted requests count into
@@ -78,6 +84,13 @@ class AdmissionPolicy:
     #: by default: most operators want degraded answers SERVED and
     #: labelled (the response's ``quality`` field), not refused.
     shed_on_quality_drift: bool = False
+    #: shed (reason ``slo_burn``) while any PAGE-severity SLO alert is
+    #: firing (``kafka_slo_alerts_firing{severity="page"}`` > 0,
+    #: ``telemetry.slo``).  Off by default (opt in via
+    #: ``kafka-serve --shed-slo``): shedding on an availability burn
+    #: is itself more rejections, so the operator chooses whether the
+    #: front door amplifies or absorbs.
+    shed_on_slo: bool = False
     #: backoff hint attached to LOAD-STATE rejections (queue_full,
     #: draining, fleet_degraded, ...): clients that honor it
     #: (tools/loadgen, the kafka-route front door) wait instead of
@@ -92,7 +105,7 @@ class AdmissionPolicy:
 #: against another replica).
 RETRYABLE_REASONS = frozenset({
     "queue_full", "prefetch_backlog", "writer_backlog", "unhealthy",
-    "fleet_degraded", "quality_degraded", "draining",
+    "fleet_degraded", "quality_degraded", "slo_burn", "draining",
 })
 
 
@@ -127,8 +140,11 @@ class AdmissionController:
             if backlog is not None and backlog > pol.max_writer_backlog:
                 return "writer_backlog"
         if pol.shed_when_unhealthy:
-            unhealthy = reg.value("kafka_health_unhealthy")
-            if unhealthy:
+            # The shared sampling path (telemetry.health.latest_verdict):
+            # the gauges probe_health maintains, no probing here.
+            from ..telemetry.health import latest_verdict
+
+            if latest_verdict(reg)["unhealthy"]:
                 return "unhealthy"
         if pol.max_dead_hosts is not None:
             dead = reg.value("kafka_fleet_dead_hosts")
@@ -138,4 +154,10 @@ class AdmissionController:
             drifting = reg.value("kafka_quality_drift_active")
             if drifting:
                 return "quality_degraded"
+        if pol.shed_on_slo:
+            firing = reg.value(
+                "kafka_slo_alerts_firing", severity="page"
+            )
+            if firing:
+                return "slo_burn"
         return None

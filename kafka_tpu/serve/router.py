@@ -69,7 +69,7 @@ DEFAULT_VNODES = 64
 #: (bad_request, unknown_tile, ...) is terminal and relayed as-is.
 RETRYABLE_REJECTIONS = frozenset({
     "queue_full", "prefetch_backlog", "writer_backlog", "unhealthy",
-    "fleet_degraded", "quality_degraded", "draining",
+    "fleet_degraded", "quality_degraded", "slo_burn", "draining",
 })
 
 
@@ -181,6 +181,12 @@ class RoutePolicy:
     max_queue_depth: Optional[int] = None
     shed_backoff_s: float = 2.0
     retry_after_s: float = 0.5
+    #: shed new submissions (reason ``slo_burn``) while any
+    #: PAGE-severity SLO alert fires on the ROUTER's own registry
+    #: (``kafka_slo_alerts_firing{severity="page"}``,
+    #: ``telemetry.slo``) — the fleet front door's opt-in version of
+    #: ``AdmissionPolicy.shed_on_slo`` (``kafka-route --shed-slo``).
+    shed_on_slo: bool = False
 
 
 class FleetWatch:
@@ -552,6 +558,10 @@ class TileRouter:
                                detail=repr(exc)[:200])
         if self._drain.is_set():
             return self._reject(req.request_id, "draining")
+        if self.policy.shed_on_slo and get_registry().value(
+            "kafka_slo_alerts_firing", severity="page"
+        ):
+            return self._reject(req.request_id, "slo_burn")
         if req.request_id in self._inflight:
             # Duplicate submission of an in-flight id: the original
             # forward already covers it.

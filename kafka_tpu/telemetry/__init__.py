@@ -40,14 +40,20 @@ scheduler, the output writers, the CLI drivers and ``bench.py``:
   utilization gauge (analytic traffic bounds shared with
   ``tools/roofline.py``), and on-demand ``jax.profiler`` capture
   (``/profilez``, ``--profile-windows``; BASELINE.md "Performance
-  observability").
+  observability");
+- :mod:`slo` — the SLO engine: declarative objectives over the metric
+  vocabulary above, multi-window burn-rate alerting (fast window
+  pages, slow window warns), a pending/firing/resolved alert state
+  machine with an ``alerts.jsonl`` ledger, and per-objective error
+  budgets (``/alertz``, ``tools/slo_report.py``; BASELINE.md "SLOs &
+  alerting").
 
 See BASELINE.md "Observability" for metric names, label conventions, the
 event schema, and "Tracing & crash forensics" for the trace/crash
 artifacts.
 """
 
-from . import flight_recorder, live, perf, quality, tracing
+from . import flight_recorder, live, perf, quality, slo, tracing
 from .compilemon import install_compile_listeners
 from .device import fetch_scalars, record_memory_watermark
 from .registry import (
@@ -71,6 +77,7 @@ __all__ = [
     "quality",
     "record_memory_watermark",
     "set_registry",
+    "slo",
     "span",
     "stopwatch",
     "tracing",

@@ -232,6 +232,9 @@ def aggregate_fleet(snapshots: List[dict], now: Optional[float] = None,
             # Per-host performance attribution (telemetry.perf; absent
             # on pre-perf snapshots).
             "perf": snap.get("perf"),
+            # Per-host SLO alert state (telemetry.slo; absent on
+            # pre-SLO snapshots).
+            "slo": snap.get("slo"),
             "crash_dumps": list(snap.get("crash_dumps") or ()),
             "status": snap.get("status") or {},
             "path": snap.get("_rel") or snap.get("_path"),
@@ -291,6 +294,18 @@ def aggregate_fleet(snapshots: List[dict], now: Optional[float] = None,
         v = q.get("last_verdict")
         if v:
             verdict_counts[v] = verdict_counts.get(v, 0) + 1
+    # Fleet SLO roll-up: an objective firing on ANY worker fires
+    # fleet-wide, deduped to one (objective, severity) entry carrying
+    # the workers it fires on — the fleet alert line fleet_status
+    # renders above the per-worker rows.
+    slo_firing: Dict[Tuple[str, str], List[str]] = {}
+    n_alerts_fired = 0
+    for w in workers:
+        s = w.get("slo") or {}
+        n_alerts_fired += int(s.get("alerts_fired") or 0)
+        for a in s.get("firing") or ():
+            key = (str(a.get("objective")), str(a.get("severity")))
+            slo_firing.setdefault(key, []).append(w["key"])
     return {
         "generated_ts": round(now, 6),
         "n_workers": len(workers),
@@ -305,6 +320,16 @@ def aggregate_fleet(snapshots: List[dict], now: Optional[float] = None,
         "quality": {
             "drifting_workers": sorted(drifting_workers),
             "last_verdicts": verdict_counts,
+        },
+        "slo": {
+            "firing": [
+                {
+                    "objective": obj, "severity": sev,
+                    "workers": sorted(wkeys),
+                }
+                for (obj, sev), wkeys in sorted(slo_firing.items())
+            ],
+            "alerts_fired": n_alerts_fired,
         },
     }
 

@@ -32,6 +32,39 @@ DEVICE_BAND = (0.4, 1.6)
 HEALTHY_HOST_MS = 1.0
 
 
+def latest_verdict(registry: Optional[MetricsRegistry] = None) -> dict:
+    """The LAST probe round's verdict, read back from the registry
+    gauges — no probing.
+
+    This is the ONE shared health-sampling path for every consumer
+    that wants the verdict without paying for a probe: ``/healthz``
+    (non-``?probe=1``), admission's ``shed_when_unhealthy``, and the
+    SLO evaluator's health context all read it, and only
+    :func:`probe_health` itself runs probes (and fires the flight
+    recorder's persistent-unhealthy trigger) — so no process ever
+    grows a second background prober.  ``unhealthy`` is None while
+    nothing probed yet."""
+    reg = registry if registry is not None else get_registry()
+    unhealthy = reg.value("kafka_health_unhealthy")
+    return {
+        "probed": unhealthy is not None,
+        "unhealthy": None if unhealthy is None else bool(unhealthy),
+        "probe_host_ms": reg.value("kafka_health_probe_host_ms"),
+        "probe_device_ms": reg.value("kafka_health_probe_device_ms"),
+    }
+
+
+def _dump_unhealthy_forensics() -> None:
+    """The flight recorder's persistent-unhealthy trigger, owned HERE
+    (next to the one probing site) so the verdict-reading consumers
+    above never re-arm it."""
+    from .flight_recorder import active_recorder
+
+    recorder = active_recorder()
+    if recorder is not None:
+        recorder.dump("unhealthy_probe")
+
+
 def probe_host(reps: int = 9,
                registry: Optional[MetricsRegistry] = None) -> float:
     """Median ms of a fixed host-side CPU workload (256^2 f32 matmul);
@@ -163,11 +196,7 @@ def probe_health(retry_wait_s: float = 15.0,
         # the run state NOW (probe event included), while the weather
         # that flagged it is live — the run may still die later with no
         # better evidence.
-        from .flight_recorder import active_recorder
-
-        recorder = active_recorder()
-        if recorder is not None:
-            recorder.dump("unhealthy_probe")
+        _dump_unhealthy_forensics()
     return {
         "probe_device_ms": None if device_ms is None
         else round(device_ms, 3),

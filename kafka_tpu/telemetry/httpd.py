@@ -11,10 +11,13 @@ loopback by default:
     run instead of waiting for ``metrics.prom`` at exit.
 ``/healthz``
     the health verdict, backed by ``telemetry.health.probe_health``:
-    by default it reads the LAST probe verdict from the registry gauge
-    (cheap enough for a load balancer's 1 Hz check); ``/healthz?probe=1``
-    runs a fresh probe round inline.  200 when healthy or unprobed,
-    503 when the verdict is off-band.
+    by default it reads the LAST probe verdict through the shared
+    ``health.latest_verdict`` sampling path (cheap enough for a load
+    balancer's 1 Hz check); ``/healthz?probe=1`` runs a fresh probe
+    round inline.  200 when healthy or unprobed, 503 when the verdict
+    is off-band — or when a PAGE-severity SLO alert is firing
+    (``telemetry.slo``; the objective is named in the body), so an
+    external load balancer inherits SLO awareness for free.
 ``/statusz``
     one JSON page of process state: pid/host/uptime, TraceContext run
     id, session/queue facts from the status provider, solver-health
@@ -34,6 +37,13 @@ loopback by default:
     status / served_from / phase durations — human text by default,
     JSON via ``?json=1``, ``?n=K`` bounds the list.  Served on both
     ``kafka-serve`` and ``kafka-route``.
+``/alertz``
+    the SLO engine's alert + error-budget view (``telemetry.slo``):
+    per-objective status (ok/pending/firing), burn rates over the
+    fast/slow windows, budget consumed/remaining and time to
+    exhaustion — human text by default, JSON via ``?json=1``.  Present
+    on every instrumented process; shows the stable disabled shape
+    when no evaluator was started.
 
 **Port 0 = disabled** at the CLI layer (:func:`maybe_start`): the
 endpoint is opt-in, a batch run should not open sockets.  The class
@@ -55,7 +65,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
-from . import perf, quality, tracing
+from . import perf, quality, slo, tracing
 from .live import build_snapshot, crash_dump_index
 from .registry import MetricsRegistry, get_registry
 
@@ -145,10 +155,12 @@ class TelemetryHTTPd:
                 self._profilez(req, reg, parse_qs(parsed.query))
             elif path == "/requestz":
                 self._requestz(req, reg, parse_qs(parsed.query))
+            elif path == "/alertz":
+                self._alertz(req, reg, parse_qs(parsed.query))
             elif path == "/":
                 self._send_json(req, 200, {
                     "endpoints": ["/metrics", "/healthz", "/statusz",
-                                  "/profilez", "/requestz"],
+                                  "/profilez", "/requestz", "/alertz"],
                 })
             else:
                 self._send_json(req, 404, {"error": f"no such endpoint "
@@ -163,24 +175,39 @@ class TelemetryHTTPd:
                 pass
 
     def _healthz(self, req, reg, query: Dict[str, list]) -> None:
+        from .health import latest_verdict
+
         verdict: Optional[dict] = None
         if query.get("probe", ["0"])[0] in ("1", "true"):
             from .health import probe_health
 
             verdict = probe_health(retry_wait_s=0.0, registry=reg)
             unhealthy: Optional[float] = float(verdict["unhealthy"])
+            last = latest_verdict(reg)
         else:
-            unhealthy = reg.value("kafka_health_unhealthy")
+            # The shared sampling path (health.latest_verdict): the
+            # gauges probe_health maintains, no probing here.
+            last = latest_verdict(reg)
+            unhealthy = last["unhealthy"]
+        # SLO integration: a firing PAGE-severity alert flips the
+        # verdict to 503 with the objective named, so external load
+        # balancers inherit SLO awareness for free.
+        slo_firing = slo.firing_pages(reg)
+        ok = not unhealthy and not slo_firing
         body = {
-            "ok": not unhealthy,
-            "verdict": ("unprobed" if unhealthy is None
-                        else "unhealthy" if unhealthy else "healthy"),
-            "probe_host_ms": reg.value("kafka_health_probe_host_ms"),
-            "probe_device_ms": reg.value("kafka_health_probe_device_ms"),
+            "ok": ok,
+            "verdict": (
+                "slo_burn" if slo_firing and not unhealthy
+                else "unprobed" if unhealthy is None
+                else "unhealthy" if unhealthy else "healthy"
+            ),
+            "probe_host_ms": last["probe_host_ms"],
+            "probe_device_ms": last["probe_device_ms"],
+            "slo_firing": slo_firing,
         }
         if verdict is not None:
             body["unhealthy_reasons"] = verdict["unhealthy_reasons"]
-        self._send_json(req, 503 if unhealthy else 200, body)
+        self._send_json(req, 200 if ok else 503, body)
 
     def _run_context(self):
         """The run's TraceContext, best source first: handler threads
@@ -265,6 +292,41 @@ class TelemetryHTTPd:
             )
         self._send(req, 200, "\n".join(lines) + "\n")
 
+    def _alertz(self, req, reg, query: Dict[str, list]) -> None:
+        """SLO alert + error-budget state (``telemetry.slo``): text by
+        default, full summary via ``?json=1``."""
+        payload = slo.summary(reg)
+        if query.get("json", ["0"])[0] in ("1", "true"):
+            self._send_json(req, 200, payload)
+            return
+        if not payload.get("enabled"):
+            self._send(req, 200, "slo engine not running\n")
+            return
+        firing = payload["firing"]
+        lines = [
+            f"slo: {len(firing)} alert(s) firing, "
+            f"{payload['alerts_fired']} fired / "
+            f"{payload['alerts_resolved']} resolved this run "
+            f"(windows {payload['fast_window_s']:g}s/"
+            f"{payload['slow_window_s']:g}s)"
+        ]
+        for a in firing:
+            lines.append(
+                f"  FIRING [{a['severity']}] {a['objective']} "
+                f"burn fast={a['burn_fast']} slow={a['burn_slow']}"
+            )
+        for name, o in payload["objectives"].items():
+            b = o["budget"]
+            tte = "-" if b.get("tte_s") is None else f"{b['tte_s']:g}s"
+            lines.append(
+                f"  {name}: {o['status']} target={o['target']:g} "
+                f"burn={o['burn_fast'] if o['burn_fast'] is not None else '-'}"
+                f"/{o['burn_slow'] if o['burn_slow'] is not None else '-'} "
+                f"budget consumed={b['consumed']:g} "
+                f"remaining={b['remaining']:g} tte={tte}"
+            )
+        self._send(req, 200, "\n".join(lines) + "\n")
+
     def _statusz(self, req, reg) -> None:
         ctx = self._run_context()
         solver = {
@@ -292,6 +354,10 @@ class TelemetryHTTPd:
             # Performance attribution (telemetry.perf): live throughput,
             # device fraction, phase breakdown, roofline utilization.
             "perf": perf.summary(reg),
+            # SLO alert + budget state (telemetry.slo): the /alertz
+            # payload inline, so one /statusz read answers "is anything
+            # firing" too.
+            "slo": slo.summary(reg),
             "crash_dumps": crash_dump_index(reg.directory),
             "status": status,
         })

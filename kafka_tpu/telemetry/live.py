@@ -39,7 +39,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from . import perf, quality, tracing
+from . import perf, quality, slo, tracing
 from .registry import MetricsRegistry, _label_text, get_registry
 
 #: snapshot schema version (bumped on breaking changes; consumers skip
@@ -150,6 +150,9 @@ def build_snapshot(registry: Optional[MetricsRegistry] = None,
         # Performance attribution (telemetry.perf): throughput / device
         # fraction / roofline utilization, per host in the fleet view.
         "perf": perf.summary(reg),
+        # SLO alert state (telemetry.slo): aggregate_fleet folds the
+        # firing alerts into the deduped fleet alert view.
+        "slo": slo.summary(reg),
         "counters": counters,
         "gauges": gauges,
         "histograms": histograms,

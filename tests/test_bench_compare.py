@@ -300,3 +300,62 @@ class TestSolverHealthDeltas:
         out = capsys.readouterr()
         assert "solver-health deltas" not in out.out
         assert "WARNING" not in out.err
+
+
+def slo_artifact(fired=0, firing=(), budget_remaining=1.0, **kw):
+    art = artifact(**kw)
+    art["slo"] = {
+        "enabled": True, "alerts_fired": fired,
+        "alerts_resolved": fired, "firing": list(firing),
+        "objectives": {},
+    }
+    art["serve_slo_alerts_total"] = fired
+    art["serve_slo_budget_remaining"] = budget_remaining
+    return art
+
+
+class TestSloDeltas:
+    """ISSUE 15 satellite: the "slo" snapshot + serve_slo_* rows diff
+    informationally, and fired alerts on a previously-clean benchmark
+    warn LOUDLY — never gate, never silence."""
+
+    def test_deltas_reported_not_gated(self, tmp_path, capsys):
+        bc = _load()
+        old = write(tmp_path, "old.json",
+                    slo_artifact(fired=1, budget_remaining=0.9))
+        new = write(tmp_path, "new.json",
+                    slo_artifact(fired=2, budget_remaining=0.5))
+        assert bc.main([old, new]) == 0
+        out = capsys.readouterr().out
+        assert "slo deltas" in out
+        assert "alerts_fired: 1 -> 2" in out
+        assert "serve_slo_budget_remaining: 0.9 -> 0.5" in out
+
+    def test_fired_alerts_on_clean_benchmark_warn(self, tmp_path,
+                                                  capsys):
+        bc = _load()
+        old = write(tmp_path, "old.json", slo_artifact(fired=0))
+        new = write(tmp_path, "new.json",
+                    slo_artifact(fired=3,
+                                 firing=["availability:page"]))
+        assert bc.main([old, new]) == 0  # a warning, not a gate
+        err = capsys.readouterr().err
+        assert "WARNING" in err and "SLO alerts fired went 0 -> 6" \
+            in err
+
+    def test_preexisting_alerts_do_not_warn(self, tmp_path, capsys):
+        bc = _load()
+        old = write(tmp_path, "old.json", slo_artifact(fired=2))
+        new = write(tmp_path, "new.json", slo_artifact(fired=3))
+        assert bc.main([old, new]) == 0
+        assert "WARNING" not in capsys.readouterr().err
+
+    def test_artifacts_without_snapshot_unaffected(self, tmp_path,
+                                                   capsys):
+        bc = _load()
+        old = write(tmp_path, "old.json", artifact())
+        new = write(tmp_path, "new.json", artifact())
+        assert bc.main([old, new]) == 0
+        out = capsys.readouterr()
+        assert "slo deltas" not in out.out
+        assert "WARNING" not in out.err

@@ -33,9 +33,10 @@ EXPECTED_KEYS = [
     "serve_fleet_p50_ms", "serve_fleet_p99_ms", "serve_fleet_replicas",
     "serve_fleet_requests_total", "serve_fleet_rerouted_total",
     "serve_backoff_total",
+    "serve_slo_alerts_total", "serve_slo_budget_remaining",
     "probe_device_ms", "probe_host_ms", "probe_retried",
     "unhealthy_reasons", "probe_host_after_ms", "unhealthy",
-    "telemetry", "solver_health", "quality", "perf",
+    "telemetry", "solver_health", "quality", "perf", "slo",
 ]
 
 HEALTH_KEYS = {
@@ -51,6 +52,7 @@ SERVE_ROWS = {
     "serve_ok_total": 24, "serve_cancelled_total": 0,
     "serve_error_total": 0,
     "serve_trace_coverage": 1.0, "serve_slowest_ms": 25.5,
+    "serve_slo_alerts_total": 0, "serve_slo_budget_remaining": 1.0,
     "live_telemetry": {
         "scrape_url": "http://127.0.0.1:1/metrics", "samples": 3,
         "scrape_errors": 0,
@@ -180,6 +182,44 @@ class TestBenchArtifactSchema:
         assert snap["windows"][q.CONSISTENT] == 1
         assert snap["windows"][q.OVERCONFIDENT] == 1
         assert snap["verdict"] == q.OVERCONFIDENT
+
+    def test_slo_snapshot_always_present(self):
+        """The SLO snapshot rides every artifact (the stable disabled
+        shape when no evaluator ran) so bench_compare can diff alert
+        state without special-casing missing keys — the quality twin
+        (ISSUE 15)."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            _, clean = _assemble(reg)
+        snap = clean["slo"]
+        assert set(snap) == {
+            "enabled", "alerts_fired", "alerts_resolved", "firing",
+            "objectives",
+        }
+        assert snap["enabled"] is False
+        assert snap["alerts_fired"] == 0 and snap["firing"] == []
+        # The serve_slo_* loadgen rows flow through (null without a
+        # serving bench).
+        assert clean["serve_slo_alerts_total"] == 0
+        assert clean["serve_slo_budget_remaining"] == 1.0
+        with telemetry.use(MetricsRegistry()) as reg:
+            _, result = _assemble(reg, serve=None)
+        assert result["serve_slo_alerts_total"] is None
+        assert result["serve_slo_budget_remaining"] is None
+        # An artifact assembled while an engine is bound carries its
+        # per-objective budget view.
+        from kafka_tpu.telemetry import slo
+
+        with telemetry.use(MetricsRegistry()) as reg:
+            eng = slo.get_engine(reg)
+            eng.evaluate_once(now=100.0)
+            _, result = _assemble(reg)
+        snap = result["slo"]
+        assert snap["enabled"] is True
+        assert set(snap["objectives"]) == {
+            "availability", "latency", "quality", "solver", "perf",
+        }
+        for o in snap["objectives"].values():
+            assert o["budget_remaining"] == 1.0
 
     def test_json_serialisable_one_line(self):
         with telemetry.use(MetricsRegistry()) as reg:

@@ -37,7 +37,7 @@ ALL_RULES = {
     "naive-marker-write", "nonfinite-launder",
     "blocking-call-in-publisher", "magic-quality-threshold",
     "ad-hoc-timing", "nondeterministic-placement",
-    "request-id-origin",
+    "request-id-origin", "magic-slo-threshold",
 }
 
 
@@ -226,7 +226,7 @@ def test_json_output_schema(capsys):
     payload = json.loads(capsys.readouterr().out)
     assert payload["version"] == 1
     assert payload["root"] == os.path.abspath(FIXTURES)
-    assert payload["files_scanned"] == 16
+    assert payload["files_scanned"] == 17
     assert set(payload["rules"]) >= ALL_RULES
     assert isinstance(payload["findings"], list) and payload["findings"]
     for f in payload["findings"]:

@@ -1,0 +1,812 @@
+"""SLO engine (ISSUE 15): declarative objectives, multi-window
+burn-rate alerts, the pending->firing->resolved state machine, the
+alerts.jsonl ledger + error budgets, and the outward wiring (/alertz,
+/healthz 503, admission slo_burn, live/fleet views, slo_report,
+bench snapshot).
+
+The chaos acceptance test pins the contract end to end: a
+fault-injected rejection storm against a real AssimilationService
+flips the availability objective pending -> firing within one fast
+window, alerts.jsonl + /alertz + fleet_status agree on the firing
+alert, admission sheds reason ``slo_burn`` when opted in, the alert
+resolves after the storm heals with the consumed budget fraction on
+the ledger — and the fault-free control run fires NOTHING (the
+zero-false-alarm pin).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from kafka_tpu import telemetry
+from kafka_tpu.resilience import RetryPolicy, faults
+from kafka_tpu.serve import AssimilationService
+from kafka_tpu.serve.admission import (
+    AdmissionController,
+    AdmissionPolicy,
+    RETRYABLE_REASONS,
+)
+from kafka_tpu.telemetry import MetricsRegistry, slo
+from kafka_tpu.telemetry.httpd import TelemetryHTTPd
+
+FAST2 = RetryPolicy(max_attempts=2, base_delay=0.0, jitter=0.0)
+
+#: seconds-fast windows for tier-1: two evaluations confirm a page
+#: well inside one fast window.
+TEST_WINDOWS = dict(fast_window_s=5.0, slow_window_s=20.0,
+                    pending_for_s=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class StubSession:
+    """Duck-typed tile session (no JAX): the serve is a constant."""
+
+    def __init__(self, name="t"):
+        self.name = name
+        self.serves = 0
+
+    def serve(self, date):
+        self.serves += 1
+        return {"status": "ok", "x_sha256": "stub",
+                "date": date.isoformat()}
+
+
+def stub_service(tmp_path, policy=None):
+    svc = AssimilationService(
+        {"t": StubSession()}, str(tmp_path),
+        policy=policy or AdmissionPolicy(max_queue_depth=8),
+        retry_policy=FAST2,
+    )
+    return svc
+
+
+def http_get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read().decode()
+
+
+def http_get_allow_error(url):
+    try:
+        return http_get(url)
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# Objective signals over the registry vocabulary.
+# ---------------------------------------------------------------------------
+
+class TestSignals:
+    def test_availability_counts_ok_vs_rejected_and_errors(self):
+        reg = MetricsRegistry()
+        obj = {o.name: o for o in slo.default_objectives()}
+        good, bad = obj["availability"].signal(reg)
+        assert (good, bad) == (0.0, 0.0)  # absent metrics read as zero
+        reg.histogram("kafka_serve_latency_seconds", "t").observe(0.01)
+        reg.counter("kafka_serve_rejected_total", "t").inc(
+            3, reason="queue_full"
+        )
+        reg.counter("kafka_serve_rejected_total", "t").inc(
+            2, reason="admit_error"
+        )
+        reg.counter("kafka_serve_errors_total", "t").inc(1)
+        good, bad = obj["availability"].signal(reg)
+        assert (good, bad) == (1.0, 6.0)  # reasons summed
+        # The router's client-visible counters fold in too.
+        reg.histogram("kafka_route_latency_seconds", "t").observe(0.02)
+        reg.counter("kafka_route_rejected_total", "t").inc(
+            1, reason="fleet_degraded"
+        )
+        good, bad = obj["availability"].signal(reg)
+        assert (good, bad) == (2.0, 7.0)
+
+    def test_latency_fraction_under_bar(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("kafka_serve_latency_seconds", "t")
+        for v in (0.01, 0.02, 0.1, 0.9):  # bar 250 ms: 3 under, 1 over
+            h.observe(v)
+        obj = [o for o in slo.default_objectives()
+               if o.name == "latency"][0]
+        good, bad = obj.signal(reg)
+        assert (good, bad) == (3.0, 1.0)
+        detail = obj.detail(reg)
+        assert detail["bar_ms"] == slo.LATENCY_BAR_MS
+        assert detail["p99_ms"] is not None
+
+    def test_solver_signal_pixels_minus_quarantined(self):
+        reg = MetricsRegistry()
+        reg.counter("kafka_engine_pixels_total", "t").inc(1000)
+        reg.counter(
+            "kafka_solver_quarantined_pixels_total", "t"
+        ).inc(7)
+        obj = [o for o in slo.default_objectives()
+               if o.name == "solver"][0]
+        assert obj.signal(reg) == (993.0, 7.0)
+
+    def test_gauge_signals_no_data_until_set(self):
+        reg = MetricsRegistry()
+        objs = {o.name: o for o in slo.default_objectives()}
+        assert objs["quality"].signal(reg) is None
+        assert objs["perf"].signal(reg) is None
+        reg.gauge("kafka_quality_drift_active", "t").set(0)
+        reg.gauge("kafka_perf_device_fraction", "t").set(0.8)
+        assert objs["quality"].signal(reg) == 0.0
+        assert objs["perf"].signal(reg) == 0.0
+        reg.gauge("kafka_quality_drift_active", "t").set(2)
+        reg.gauge("kafka_perf_device_fraction", "t").set(0.01)
+        assert objs["quality"].signal(reg) == 1.0
+        assert objs["perf"].signal(reg) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# The alert state machine + burn-rate arithmetic (deterministic via
+# evaluate_once(now=...) — no sleeps).
+# ---------------------------------------------------------------------------
+
+def storm(reg, n=10, reason="queue_full"):
+    reg.counter(
+        "kafka_serve_rejected_total",
+        "requests shed at admission",
+    ).inc(n, reason=reason)
+
+
+class TestStateMachine:
+    def make(self, reg, **kw):
+        cfg = dict(TEST_WINDOWS)
+        cfg.update(kw)
+        return slo.SLOEngine(registry=reg, **cfg)
+
+    def test_pending_then_firing_then_resolved(self):
+        with telemetry.use(MetricsRegistry()) as reg:
+            eng = self.make(reg)
+            eng.evaluate_once(now=100.0)  # baseline
+            storm(reg)
+            s = eng.evaluate_once(now=100.5)
+            avail = s["objectives"]["availability"]
+            assert avail["status"] == "pending"
+            assert avail["burn_fast"] > slo.FAST_BURN_THRESHOLD
+            s = eng.evaluate_once(now=101.0)
+            assert s["objectives"]["availability"]["status"] == "firing"
+            assert {(a["objective"], a["severity"])
+                    for a in s["firing"]} == {
+                ("availability", "page"), ("availability", "warn"),
+            }
+            assert reg.value(
+                "kafka_slo_alerts_firing", severity="page"
+            ) == 1
+            assert reg.value(
+                "kafka_slo_alerts_fired_total", severity="page"
+            ) == 1
+            events = [e["event"] for e in reg.events]
+            assert "slo_alert" in events
+            # Storm heals: the fast window slides past the rejections,
+            # the page resolves; the slow window still covers them.
+            s = eng.evaluate_once(now=110.0)
+            sev = s["objectives"]["availability"]["alerts"]
+            assert sev["page"] == "ok" and sev["warn"] == "firing"
+            assert reg.value(
+                "kafka_slo_alerts_firing", severity="page"
+            ) == 0
+            assert "slo_resolved" in [e["event"] for e in reg.events]
+            # ... and past the slow window everything resolves.
+            s = eng.evaluate_once(now=140.0)
+            assert s["objectives"]["availability"]["status"] == "ok"
+            assert s["alerts_fired"] == 2
+            assert s["alerts_resolved"] == 2
+
+    def test_pending_clears_silently_without_confirmation(self):
+        with telemetry.use(MetricsRegistry()) as reg:
+            eng = self.make(reg, pending_for_s=10.0)
+            eng.evaluate_once(now=100.0)
+            storm(reg)
+            s = eng.evaluate_once(now=100.5)
+            assert s["objectives"]["availability"]["status"] == \
+                "pending"
+            # The PAGE breach ages out of the fast window before
+            # pending_for_s elapses: that alert never fires (the slow
+            # window legitimately still covers the storm, so only the
+            # warn side may progress).
+            s = eng.evaluate_once(now=120.0)
+            assert s["objectives"]["availability"]["alerts"][
+                "page"] == "ok"
+            page_kinds = [r["kind"] for r in eng.ledger.records
+                          if r["severity"] == "page"]
+            assert "firing" not in page_kinds
+
+    def test_clean_run_fires_nothing(self):
+        """Zero-false-alarm pin: healthy traffic at any volume never
+        alerts."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            eng = self.make(reg)
+            h = reg.histogram("kafka_serve_latency_seconds", "t")
+            for i in range(50):
+                h.observe(0.01)
+                eng.evaluate_once(now=100.0 + i)
+            s = eng.summary()
+            assert s["alerts_fired"] == 0
+            assert s["firing"] == []
+            assert list(eng.ledger.records) == []
+            assert all(
+                o["status"] in ("ok", "no_data")
+                for o in s["objectives"].values()
+            )
+
+    def test_gauge_objective_pages_on_sustained_drift(self):
+        with telemetry.use(MetricsRegistry()) as reg:
+            eng = self.make(reg)
+            reg.gauge("kafka_quality_drift_active", "t").set(2)
+            for i in range(3):
+                s = eng.evaluate_once(now=100.0 + i)
+            assert s["objectives"]["quality"]["status"] == "firing"
+            assert ("quality", "page") in {
+                (a["objective"], a["severity"]) for a in s["firing"]
+            }
+
+    def test_perf_objective_warns_but_cannot_page(self):
+        """Target 0.90 bounds the burn at 10 < the 14.4 page
+        threshold: a throughput floor breach warns on the slow window,
+        never pages."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            eng = self.make(reg)
+            reg.gauge("kafka_perf_device_fraction", "t").set(0.001)
+            s = None
+            for i in range(40):
+                s = eng.evaluate_once(now=100.0 + i)
+            alerts = s["objectives"]["perf"]["alerts"]
+            assert alerts["page"] == "ok"
+            assert alerts["warn"] == "firing"
+
+    def test_budget_ledger_consumed_and_tte(self):
+        with telemetry.use(MetricsRegistry()) as reg:
+            eng = self.make(reg, budget_window_s=3600.0)
+            eng.evaluate_once(now=100.0)
+            h = reg.histogram("kafka_serve_latency_seconds", "t")
+            for _ in range(999):
+                h.observe(0.01)
+            storm(reg, n=1)
+            s = eng.evaluate_once(now=101.0)
+            b = s["objectives"]["availability"]["budget"]
+            # 1 bad / 1000 total = exactly the 0.001 error budget.
+            assert b["consumed"] == pytest.approx(1.0, rel=1e-3)
+            assert b["remaining"] == pytest.approx(0.0, abs=1e-3)
+        # Fresh engine, milder burn: budget partially consumed, tte
+        # scales the budget window by the remaining fraction.
+        with telemetry.use(MetricsRegistry()) as reg:
+            eng = self.make(reg, budget_window_s=3600.0)
+            eng.evaluate_once(now=100.0)
+            h = reg.histogram("kafka_serve_latency_seconds", "t")
+            for _ in range(1999):
+                h.observe(0.01)
+            storm(reg, n=1)
+            s = eng.evaluate_once(now=101.0)
+            b = s["objectives"]["availability"]["budget"]
+            assert 0.4 < b["consumed"] < 0.6
+            assert b["tte_s"] is not None and b["tte_s"] > 0
+
+    def test_evaluator_thread_smoke(self):
+        """The tracked background thread evaluates on its own and
+        stop() lands a final round."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            eng = slo.SLOEngine(registry=reg, interval_s=0.05,
+                                **TEST_WINDOWS)
+            eng.start()
+            # Let the evaluator take its pre-traffic baseline sample
+            # first — counters that climbed before the first
+            # evaluation are history, not in-window burn.
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and not \
+                    reg.value("kafka_slo_evaluations_total"):
+                time.sleep(0.02)
+            storm(reg, n=20)
+            try:
+                while time.monotonic() < deadline:
+                    if reg.value("kafka_slo_alerts_firing",
+                                 severity="page"):
+                        break
+                    time.sleep(0.02)
+                assert reg.value(
+                    "kafka_slo_alerts_firing", severity="page"
+                ) == 1
+            finally:
+                eng.stop()
+            assert reg.value("kafka_slo_evaluations_total") >= 2
+            names = [t.name for t in threading.enumerate()]
+            assert "slo-evaluator" not in names
+
+
+# ---------------------------------------------------------------------------
+# alerts.jsonl: rotation discipline + loading.
+# ---------------------------------------------------------------------------
+
+class TestAlertLedger:
+    def test_records_written_and_rotated(self, tmp_path):
+        led = slo._AlertLedger(str(tmp_path), rotate_bytes=400, keep=2)
+        for i in range(20):
+            led.append({"schema": 1, "ts": float(i), "kind": "firing",
+                        "objective": "availability",
+                        "severity": "page"})
+        names = sorted(os.listdir(tmp_path))
+        assert slo.ALERTS_FILENAME in names
+        assert any(n.startswith("alerts.jsonl.") for n in names)
+        assert not any(n.endswith(".3") for n in names)  # keep=2
+        records, skipped = slo.load_alerts(
+            str(tmp_path / slo.ALERTS_FILENAME)
+        )
+        assert skipped == 0
+        # Oldest-first across segments: timestamps monotone.
+        ts = [r["ts"] for r in records]
+        assert ts == sorted(ts)
+
+    def test_torn_tail_skipped(self, tmp_path):
+        path = tmp_path / slo.ALERTS_FILENAME
+        rec = {"schema": 1, "ts": 1.0, "kind": "firing",
+               "objective": "a", "severity": "page"}
+        path.write_text(json.dumps(rec) + "\n" + '{"torn": ')
+        records, skipped = slo.load_alerts(str(path))
+        assert len(records) == 1 and skipped == 1
+
+    def test_in_memory_without_directory(self):
+        led = slo._AlertLedger(None)
+        led.append({"kind": "firing", "objective": "a"})
+        assert len(led.records) == 1 and led.path is None
+
+
+# ---------------------------------------------------------------------------
+# /alertz + /healthz + /statusz (satellite 1) and admission slo_burn.
+# ---------------------------------------------------------------------------
+
+class TestEndpoints:
+    def test_alertz_and_healthz_flip_on_firing_page(self):
+        with telemetry.use(MetricsRegistry()) as reg:
+            eng = slo.get_engine(reg, **TEST_WINDOWS)
+            httpd = TelemetryHTTPd(port=0, role="serve").start()
+            try:
+                code, body = http_get(httpd.url + "/alertz?json=1")
+                assert code == 200
+                assert json.loads(body)["enabled"] is True
+                code, _ = http_get(httpd.url + "/healthz")
+                assert code == 200
+                eng.evaluate_once(now=100.0)
+                storm(reg)
+                eng.evaluate_once(now=100.5)
+                eng.evaluate_once(now=101.0)
+                # /alertz (json + text) reports the firing alert ...
+                code, body = http_get(httpd.url + "/alertz?json=1")
+                payload = json.loads(body)
+                assert payload["objectives"]["availability"][
+                    "status"] == "firing"
+                code, text = http_get(httpd.url + "/alertz")
+                assert "FIRING [page] availability" in text
+                # ... /healthz flips 503 naming the objective
+                # (satellite: load balancers inherit SLO awareness) ...
+                code, body = http_get_allow_error(
+                    httpd.url + "/healthz"
+                )
+                assert code == 503
+                health = json.loads(body)
+                assert health["verdict"] == "slo_burn"
+                assert health["slo_firing"] == ["availability"]
+                # ... and /statusz carries the summary inline.
+                code, body = http_get(httpd.url + "/statusz")
+                assert json.loads(body)["slo"]["objectives"][
+                    "availability"]["status"] == "firing"
+                # Resolution restores 200.
+                eng.evaluate_once(now=140.0)
+                code, body = http_get(httpd.url + "/healthz")
+                assert code == 200
+                assert json.loads(body)["slo_firing"] == []
+            finally:
+                httpd.close()
+
+    def test_healthz_unprobed_stays_200_without_engine(self):
+        with telemetry.use(MetricsRegistry()):
+            httpd = TelemetryHTTPd(port=0).start()
+            try:
+                code, body = http_get(httpd.url + "/healthz")
+                assert code == 200
+                assert json.loads(body)["verdict"] == "unprobed"
+                code, body = http_get(httpd.url + "/alertz")
+                assert "not running" in body
+            finally:
+                httpd.close()
+
+
+class TestAdmissionShedding:
+    def test_sheds_slo_burn_when_opted_in(self):
+        with telemetry.use(MetricsRegistry()) as reg:
+            reg.gauge("kafka_slo_alerts_firing", "t").set(
+                1, severity="page"
+            )
+            on = AdmissionController(AdmissionPolicy(shed_on_slo=True))
+            off = AdmissionController(AdmissionPolicy())
+            assert on.decide(queue_depth=0) == "slo_burn"
+            assert off.decide(queue_depth=0) is None
+            # slo_burn is a server-state rejection: it carries the
+            # backoff hint.
+            assert "slo_burn" in RETRYABLE_REASONS
+            assert on.retry_after("slo_burn") == \
+                AdmissionPolicy().retry_after_s
+
+    def test_clears_when_alert_resolves(self):
+        with telemetry.use(MetricsRegistry()) as reg:
+            reg.gauge("kafka_slo_alerts_firing", "t").set(
+                0, severity="page"
+            )
+            on = AdmissionController(AdmissionPolicy(shed_on_slo=True))
+            assert on.decide(queue_depth=0) is None
+
+    def test_router_policy_has_the_knob(self):
+        from kafka_tpu.serve.router import (
+            RETRYABLE_REJECTIONS, RoutePolicy,
+        )
+
+        assert RoutePolicy().shed_on_slo is False
+        assert RoutePolicy(shed_on_slo=True).shed_on_slo is True
+        assert "slo_burn" in RETRYABLE_REJECTIONS
+
+
+# ---------------------------------------------------------------------------
+# Live snapshots, fleet aggregation, fleet_status render.
+# ---------------------------------------------------------------------------
+
+class TestFleetView:
+    def _snap(self, pid, firing):
+        return {
+            "schema": 1, "ts": time.time(), "host": "h", "pid": pid,
+            "role": "serve", "seq": 1, "interval_s": 2.0,
+            "final": False, "run_id": None, "chunk_id": None,
+            "health": {"unhealthy": None},
+            "counters": {}, "gauges": {}, "histograms": {},
+            "slo": {
+                "enabled": True, "started": True,
+                "alerts_fired": len(firing), "alerts_resolved": 0,
+                "firing": [
+                    {"objective": o, "severity": s}
+                    for o, s in firing
+                ],
+                "objectives": {},
+            },
+            "series_truncated": 0, "crash_dumps": [], "status": {},
+        }
+
+    def test_live_snapshot_carries_slo(self):
+        from kafka_tpu.telemetry.live import build_snapshot
+
+        with telemetry.use(MetricsRegistry()) as reg:
+            eng = slo.get_engine(reg, **TEST_WINDOWS)
+            eng.evaluate_once(now=100.0)
+            snap = build_snapshot(reg, role="serve")
+        assert snap["slo"]["enabled"] is True
+        assert "availability" in snap["slo"]["objectives"]
+
+    def test_fleet_dedupes_firing_objectives(self):
+        from kafka_tpu.telemetry.aggregate import aggregate_fleet
+
+        fleet = aggregate_fleet([
+            self._snap(1, [("availability", "page")]),
+            self._snap(2, [("availability", "page"),
+                           ("latency", "warn")]),
+            self._snap(3, []),
+        ])
+        firing = fleet["slo"]["firing"]
+        assert {(f["objective"], f["severity"]) for f in firing} == {
+            ("availability", "page"), ("latency", "warn"),
+        }
+        avail = [f for f in firing
+                 if f["objective"] == "availability"][0]
+        # One fleet alert, both workers attributed.
+        assert avail["workers"] == ["h:1", "h:2"]
+        assert fleet["slo"]["alerts_fired"] == 3
+
+    def test_fleet_status_renders_alert_lines(self):
+        from tools.fleet_status import render
+        from kafka_tpu.telemetry.aggregate import aggregate_fleet
+
+        fleet = aggregate_fleet([
+            self._snap(1, [("availability", "page")]),
+            self._snap(2, [("availability", "page")]),
+        ])
+        fleet["queue"] = None
+        text = render(fleet)
+        assert "slo=FIRING[availability(page)]" in text
+        assert "SLO ALERT FIRING: availability [page] on h:1, h:2" \
+            in text
+
+
+# ---------------------------------------------------------------------------
+# tools/slo_report.py: the error-budget report over alerts.jsonl.
+# ---------------------------------------------------------------------------
+
+class TestSloReport:
+    def _run_episode(self, tmp_path):
+        """One storm -> firing -> resolved arc with a ledger on disk;
+        returns (engine summary, ledger dir)."""
+        with telemetry.use(MetricsRegistry(str(tmp_path))) as reg:
+            eng = slo.SLOEngine(registry=reg, **TEST_WINDOWS)
+            eng.evaluate_once(now=100.0)
+            reg.histogram(
+                "kafka_serve_latency_seconds", "t"
+            ).observe(0.01)
+            storm(reg, n=10)
+            eng.evaluate_once(now=100.5)
+            eng.evaluate_once(now=101.0)
+            eng.evaluate_once(now=140.0)
+            return eng.summary(), str(tmp_path)
+
+    def test_json_reproduces_episode_from_ledger_alone(
+            self, tmp_path, capsys):
+        from tools.slo_report import main
+
+        summary, root = self._run_episode(tmp_path)
+        rc = main([root, "--json"])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        # The episode reconstructs from alerts.jsonl ALONE: both
+        # severities fired at 101.0 and resolved when their windows
+        # slid clear.
+        eps = {(e["objective"], e["severity"]): e
+               for e in report["episodes"]}
+        page = eps[("availability", "page")]
+        assert page["pending_ts"] == 100.5
+        assert page["firing_ts"] == 101.0
+        assert page["resolved_ts"] == 140.0
+        assert page["duration_s"] == pytest.approx(39.0)
+        assert page["burn_fast"] > slo.FAST_BURN_THRESHOLD
+        obj = report["objectives"]["availability"]
+        assert obj["episodes"] == 2 and obj["open_episodes"] == 0
+        assert obj["worst_burn_fast"] > slo.FAST_BURN_THRESHOLD
+        # Budget remaining matches the live engine's final ledger.
+        live_budget = summary["objectives"]["availability"]["budget"]
+        assert obj["budget"]["remaining"] == pytest.approx(
+            live_budget["remaining"], abs=1e-6
+        )
+
+    def test_human_render_and_open_episode(self, tmp_path, capsys):
+        from tools.slo_report import main
+
+        with telemetry.use(MetricsRegistry(str(tmp_path))) as reg:
+            eng = slo.SLOEngine(registry=reg, **TEST_WINDOWS)
+            eng.evaluate_once(now=100.0)
+            storm(reg, n=10)
+            eng.evaluate_once(now=100.5)
+            eng.evaluate_once(now=101.0)  # firing, never resolved
+        rc = main([str(tmp_path)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "availability" in out and "OPEN" in out
+
+    def test_no_ledger_is_usage_error(self, tmp_path, capsys):
+        from tools.slo_report import main
+
+        rc = main([str(tmp_path)])
+        assert rc == 2
+
+    def test_clean_ledger_reports_full_budget(self, tmp_path, capsys):
+        from tools.slo_report import main
+
+        (tmp_path / slo.ALERTS_FILENAME).write_text("")
+        rc = main([str(tmp_path), "--json"])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["records"] == 0
+        assert report["objectives"] == {}
+
+
+# ---------------------------------------------------------------------------
+# Chaos acceptance (ISSUE 15): rejection storm against a REAL service.
+# ---------------------------------------------------------------------------
+
+class TestChaosAcceptance:
+    def test_rejection_storm_fires_resolves_and_sheds(self, tmp_path):
+        """serve.admit fault storm -> availability pending -> firing
+        within one fast window; alerts.jsonl + /alertz + fleet_status
+        agree; admission sheds slo_burn (opted in); after the storm
+        heals the alert resolves and the ledger carries the consumed
+        budget fraction."""
+        from tools.fleet_status import build_view
+        from kafka_tpu.telemetry import live
+
+        tel = str(tmp_path / "tel")
+        with telemetry.use(MetricsRegistry(tel)) as reg:
+            svc = stub_service(
+                tmp_path / "serve",
+                policy=AdmissionPolicy(max_queue_depth=64,
+                                       shed_on_slo=True),
+            ).start()
+            eng = slo.get_engine(reg, fast_window_s=5.0,
+                                 slow_window_s=12.0,
+                                 pending_for_s=0.0)
+            httpd = TelemetryHTTPd(port=0, role="serve").start()
+            try:
+                t0 = 1000.0
+                eng.evaluate_once(now=t0)
+                # Healthy traffic first: the control half of the run.
+                for i in range(4):
+                    ack = svc.submit(
+                        {"tile": "t", "date": "2017-07-05",
+                         "request_id": f"ok{i}"}
+                    )
+                    assert ack["status"] == "queued"
+                    assert svc.result(f"ok{i}", timeout_s=30.0)[
+                        "status"] == "ok"
+                s = eng.evaluate_once(now=t0 + 0.2)
+                assert s["firing"] == []
+                # The storm: every admission faulted for 12 calls.
+                faults.script("serve.admit", "1-12", faults.TRANSIENT)
+                storm_start = t0 + 0.3
+                for i in range(12):
+                    ack = svc.submit(
+                        {"tile": "t", "date": "2017-07-05",
+                         "request_id": f"bad{i}"}
+                    )
+                    assert ack["status"] == "rejected"
+                    assert ack["reason"] == "admit_error"
+                # pending -> firing within ONE fast window.
+                s = eng.evaluate_once(now=t0 + 0.5)
+                assert s["objectives"]["availability"]["status"] == \
+                    "pending"
+                s = eng.evaluate_once(now=t0 + 0.8)
+                assert s["objectives"]["availability"]["status"] == \
+                    "firing"
+                firing_rec = [r for r in eng.ledger.records
+                              if r["kind"] == "firing"][0]
+                assert firing_rec["ts"] - storm_start < \
+                    eng.fast_window_s
+                # alerts.jsonl, /alertz and fleet_status AGREE.
+                records, skipped = slo.load_alerts(
+                    os.path.join(tel, slo.ALERTS_FILENAME)
+                )
+                assert skipped == 0
+                assert ("availability", "page", "firing") in {
+                    (r["objective"], r["severity"], r["kind"])
+                    for r in records
+                }
+                _, body = http_get(httpd.url + "/alertz?json=1")
+                assert json.loads(body)["objectives"][
+                    "availability"]["status"] == "firing"
+                live.LivePublisher(tel, role="serve",
+                                   registry=reg).publish_now()
+                fleet = build_view(tel)
+                assert {(f["objective"], f["severity"])
+                        for f in fleet["slo"]["firing"]} >= {
+                    ("availability", "page"),
+                }
+                # Admission sheds slo_burn while the page fires
+                # (faults exhausted: the fault point passes now).
+                ack = svc.submit({"tile": "t", "date": "2017-07-05",
+                                  "request_id": "shed0"})
+                assert ack["status"] == "rejected"
+                assert ack["reason"] == "slo_burn"
+                assert ack["retry_after_s"] > 0
+                # Heal: one evaluation lands the shed rejection in a
+                # sample (shedding IS burn — the operator's tradeoff),
+                # then the windows slide past the whole storm, the
+                # alert resolves and admission admits again.
+                eng.evaluate_once(now=t0 + 5.0)
+                s = eng.evaluate_once(now=t0 + 30.0)
+                assert s["objectives"]["availability"]["status"] == \
+                    "ok"
+                assert s["alerts_resolved"] >= 2
+                ack = svc.submit({"tile": "t", "date": "2017-07-05",
+                                  "request_id": "after0"})
+                assert ack["status"] == "queued"
+                assert svc.result("after0", timeout_s=30.0)[
+                    "status"] == "ok"
+                # The budget ledger shows the storm's consumed
+                # fraction (13 bad vs 6 ok >> the 0.001 budget).
+                b = s["objectives"]["availability"]["budget"]
+                assert b["consumed"] > 1.0
+                assert b["remaining"] == 0.0
+                resolved = [r for r in slo.load_alerts(
+                    os.path.join(tel, slo.ALERTS_FILENAME)
+                )[0] if r["kind"] == "resolved"]
+                assert resolved and all(
+                    r["budget"]["consumed"] > 1.0 for r in resolved
+                )
+            finally:
+                httpd.close()
+                svc.close()
+
+    def test_fault_free_control_run_fires_nothing(self, tmp_path):
+        """The zero-false-alarm pin: the identical setup without the
+        fault storm alerts on NOTHING and writes no ledger."""
+        tel = str(tmp_path / "tel")
+        with telemetry.use(MetricsRegistry(tel)) as reg:
+            svc = stub_service(tmp_path / "serve").start()
+            eng = slo.get_engine(reg, fast_window_s=5.0,
+                                 slow_window_s=12.0,
+                                 pending_for_s=0.0)
+            try:
+                t0 = 1000.0
+                eng.evaluate_once(now=t0)
+                for i in range(16):
+                    ack = svc.submit(
+                        {"tile": "t", "date": "2017-07-05",
+                         "request_id": f"c{i}"}
+                    )
+                    assert ack["status"] == "queued"
+                    assert svc.result(f"c{i}", timeout_s=30.0)[
+                        "status"] == "ok"
+                    eng.evaluate_once(now=t0 + 0.1 * (i + 1))
+                s = eng.evaluate_once(now=t0 + 30.0)
+                assert s["alerts_fired"] == 0 and s["firing"] == []
+                assert not os.path.exists(
+                    os.path.join(tel, slo.ALERTS_FILENAME)
+                )
+            finally:
+                svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Engine-run integration: the pixels counter + driver wiring.
+# ---------------------------------------------------------------------------
+
+class TestEngineIntegration:
+    def test_engine_counts_assimilated_pixels(self, tmp_path):
+        """kafka_engine_pixels_total (the solver objective's
+        denominator) counts n_valid per assimilated window with zero
+        added device reads."""
+        from test_quality import run_identity_engine
+
+        kf, out, reg = run_identity_engine()
+        pixels = reg.value("kafka_engine_pixels_total")
+        windows = sum(
+            v for (k, v) in [
+                (key, val) for key, val in reg.flat().items()
+                if key.startswith("kafka_engine_windows_total")
+            ]
+        )
+        assert pixels is not None and pixels > 0
+        assert pixels == kf.gather.n_valid * windows
+        # The solver objective reads it: clean run -> zero bad.
+        obj = [o for o in slo.default_objectives()
+               if o.name == "solver"][0]
+        good, bad = obj.signal(reg)
+        assert good == pixels and bad == 0
+
+    def test_run_synthetic_starts_and_stops_the_evaluator(
+            self, tmp_path):
+        """Driver wiring: a clean CPU run_synthetic run evaluates SLOs
+        (evaluations counted, gauges exported) and fires nothing."""
+        from kafka_tpu.cli.run_synthetic import main
+        from kafka_tpu.telemetry import get_registry, set_registry
+
+        tel = str(tmp_path / "tel")
+        prev = get_registry()
+        try:
+            summary = main([
+                "--operator", "identity", "--ny", "40", "--nx", "40",
+                "--outdir", str(tmp_path / "out"),
+                "--telemetry-dir", tel,
+            ])
+        finally:
+            set_registry(prev)
+        assert summary["n_pixels"] > 0
+        with open(os.path.join(tel, "metrics.prom")) as f:
+            prom = f.read()
+        assert "kafka_slo_evaluations_total" in prom
+        assert 'kafka_slo_alerts_firing{severity="page"} 0' in prom
+        # Clean run: no alert ledger (the zero-false-alarm pin at the
+        # driver level), and the started event is on the record.
+        assert not os.path.exists(
+            os.path.join(tel, slo.ALERTS_FILENAME)
+        )
+        with open(os.path.join(tel, "events.jsonl")) as f:
+            events = [json.loads(l)["event"] for l in f if l.strip()]
+        assert "slo_engine_started" in events

@@ -24,7 +24,12 @@ write-only.  This tool makes it actionable:
   verdict counts + drift-sentinel state) informationally, with a LOUD
   warning when a previously-CONSISTENT benchmark flips verdict or its
   drift sentinels go 0 -> alarming — mirroring the ``solver_health``
-  quarantine warning.
+  quarantine warning;
+- diffs the embedded ``"slo"`` snapshots and ``serve_slo_*`` rows
+  informationally, with the same class of LOUD warning when a
+  previously-clean artifact (zero SLO alerts) shows fired burn-rate
+  alerts — a bench that got faster by burning its error budget must
+  not read as a clean win.
 
 Usage:
     python tools/bench_compare.py OLD.json NEW.json [--threshold 0.10]
@@ -198,6 +203,52 @@ def quality_deltas(old: dict, new: dict) -> Tuple[List[str], List[str]]:
     return warnings, lines
 
 
+def slo_deltas(old: dict, new: dict) -> Tuple[List[str], List[str]]:
+    """(warnings, report_lines) over the embedded ``slo`` snapshots
+    (bench.py's compact alert/budget view) plus the serve_slo_* rows.
+
+    Diffed INFORMATIONALLY like ``solver_health``/``quality`` — an
+    alert is an operations signal, not a timing gate — with the same
+    class of loud exception: a previously-clean artifact (zero alerts
+    fired) whose new run FIRED alerts burned error budget to get its
+    numbers, so it surfaces as an explicit warning.  Still exit 0.
+    """
+    s_old = old.get("slo") or {}
+    s_new = new.get("slo") or {}
+    warnings: List[str] = []
+    lines: List[str] = []
+    for key in ("alerts_fired", "alerts_resolved"):
+        a, b = s_old.get(key, 0) or 0, s_new.get(key, 0) or 0
+        if a or b:
+            lines.append(f"  {key}: {a:g} -> {b:g}")
+    f_old = s_old.get("firing") or []
+    f_new = s_new.get("firing") or []
+    if f_old or f_new:
+        lines.append(
+            f"  firing: {','.join(f_old) or '-'} -> "
+            f"{','.join(f_new) or '-'}"
+        )
+    for key in ("serve_slo_alerts_total", "serve_slo_budget_remaining"):
+        a, b = old.get(key), new.get(key)
+        if a is None and b is None:
+            continue
+        fmt = (lambda v: "-" if v is None else f"{v:g}")
+        lines.append(f"  {key}: {fmt(a)} -> {fmt(b)}")
+    old_fired = float(s_old.get("alerts_fired") or 0) + \
+        float(old.get("serve_slo_alerts_total") or 0)
+    new_fired = float(s_new.get("alerts_fired") or 0) + \
+        float(new.get("serve_slo_alerts_total") or 0)
+    if new_fired > 0 and old_fired == 0:
+        warnings.append(
+            f"SLO alerts fired went 0 -> {new_fired:g}: the new "
+            "artifact burned error budget (burn-rate alerts fired "
+            "during the bench) on a previously-clean benchmark — "
+            "inspect alerts.jsonl (tools/slo_report.py) before "
+            "trusting its timings"
+        )
+    return warnings, lines
+
+
 def live_telemetry_deltas(old: dict, new: dict) -> List[str]:
     """Informational diff of the embedded ``live_telemetry`` mid-run
     scrape series (tools/loadgen): per shared series, the peak and the
@@ -363,6 +414,13 @@ def main(argv=None) -> int:
         for line in quality_lines:
             print(line)
     for w in quality_warnings:
+        print(f"bench_compare: WARNING {w}", file=sys.stderr)
+    slo_warnings, slo_lines = slo_deltas(old, new)
+    if slo_lines:
+        print("slo deltas (alerts / error budget, not gated):")
+        for line in slo_lines:
+            print(line)
+    for w in slo_warnings:
         print(f"bench_compare: WARNING {w}", file=sys.stderr)
     unhealthy = [
         name for name, art in (("old", old), ("new", new))

@@ -56,6 +56,15 @@ def render(fleet: dict) -> str:
             extra += f"  perf={p['px_steps_per_s']:.3g}px/s"
             if p.get("device_fraction") is not None:
                 extra += f",df={p['device_fraction']:.2f}"
+        # Per-worker SLO alert state (telemetry.slo): name the firing
+        # objectives inline; the deduped fleet line renders below.
+        s = w.get("slo") or {}
+        if s.get("firing"):
+            shown = ",".join(
+                f"{a.get('objective')}({a.get('severity')})"
+                for a in s["firing"][:4]
+            )
+            extra += f"  slo=FIRING[{shown}]"
         if w["crash_dumps"]:
             extra += f"  crash={w['crash_dumps'][-1]}"
         lines.append(
@@ -87,6 +96,15 @@ def render(fleet: dict) -> str:
         lines.append(
             "quality drift ACTIVE on: "
             + ", ".join(fq["drifting_workers"])
+        )
+    # Fleet SLO alert line (telemetry.aggregate roll-up): an objective
+    # firing on ANY worker fires fleet-wide, deduped per (objective,
+    # severity) with the workers it fires on.
+    fs = fleet.get("slo") or {}
+    for a in fs.get("firing") or ():
+        lines.append(
+            f"SLO ALERT FIRING: {a['objective']} [{a['severity']}] "
+            f"on {', '.join(a['workers'])}"
         )
     queue = fleet.get("queue")
     if queue:

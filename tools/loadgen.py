@@ -389,8 +389,31 @@ def bench_serve(
             dates[-4:], sorted(sessions), requests
         )
         scraper = _MetricsScraper(httpd.url).start()
-        rows = run_load(target, plan, concurrency=concurrency,
-                        timeout_s=600.0)
+        # SLO ride-along (kafka_tpu.telemetry.slo): a fast-windowed
+        # evaluator over the bench registry, started AFTER the cold
+        # warm-up (its first sample is the measured window's baseline)
+        # — the artifact carries whether the bench burned any error
+        # budget next to how fast it went.
+        from kafka_tpu.telemetry import slo as _slo
+
+        engine = _slo.SLOEngine(
+            fast_window_s=30.0, slow_window_s=120.0, interval_s=0.25,
+        ).start()
+        try:
+            rows = run_load(target, plan, concurrency=concurrency,
+                            timeout_s=600.0)
+        finally:
+            engine.stop()
+        summary = engine.summary()
+        remaining = [
+            (o.get("budget") or {}).get("remaining")
+            for o in summary["objectives"].values()
+            if (o.get("budget") or {}).get("remaining") is not None
+        ]
+        rows["serve_slo_alerts_total"] = summary["alerts_fired"]
+        rows["serve_slo_budget_remaining"] = (
+            round(min(remaining), 6) if remaining else None
+        )
         rows["serve_cold_ms"] = cold_ms
         rows["live_telemetry"] = scraper.stop()
         scraper = None
