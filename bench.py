@@ -343,6 +343,7 @@ def assemble_result(
     fused_lin=None,        # (px_s, ms_median, ms_spread) or None (off-TPU)
     serve=None,            # tools/loadgen rows dict or None
     fleet=None,            # tools/loadgen bench_fleet rows dict or None
+    smoother=None,         # bench_smoother_rows dict or None
     n_matched: int = 16384,
     n_device: int = 1 << 19,
     registry=None,
@@ -421,6 +422,15 @@ def assemble_result(
         else round(fl_spread_ms, 3),
         "device_pallas_fused_lin_px_s": None if fl_px_s is None
         else round(fl_px_s, 1),
+        # Reanalysis solve rows (bench_smoother_rows: the jitted RTS
+        # backward sweep over a synthetic in-memory chain).  The _ms row
+        # gates in tools/bench_compare.py via the device_*_ms pattern;
+        # the px_s twin gates larger-is-better (its own pattern there) —
+        # both null when the smoother bench failed.
+        "device_smoother_ms": None if smoother is None
+        else smoother.get("device_smoother_ms"),
+        "device_smoother_px_s": None if smoother is None
+        else smoother.get("device_smoother_px_s"),
         "e2e_pixel_steps_per_s": round(e2e_px_steps_s, 1),
         # Max-min over the measured passes (bench_end_to_end medians k
         # passes): the r03-r05 rows swung ~2x with no code change, so
@@ -449,6 +459,14 @@ def assemble_result(
         # their wall time, and the single slowest request — the
         # observability-coverage health of the serving path, diffed
         # informationally by tools/bench_compare.py (no gate yet).
+        # Reanalysis serving rows (tools/loadgen's --smoothed mix: every
+        # Kth request reads the RTS-smoothed state off the checkpoint
+        # chain).  serve_smoothed_p99_ms gates in bench_compare like the
+        # forward serving rows.
+        "serve_smoothed_p50_ms": None if serve is None
+        else serve.get("serve_smoothed_p50_ms"),
+        "serve_smoothed_p99_ms": None if serve is None
+        else serve.get("serve_smoothed_p99_ms"),
         "serve_trace_coverage": None if serve is None
         else serve.get("serve_trace_coverage"),
         "serve_slowest_ms": None if serve is None
@@ -678,6 +696,7 @@ def _bench_rows():
             file=sys.stderr,
         )
     e2e = bench_end_to_end()
+    smoother = bench_smoother_rows()
     serve = bench_serve_rows()
     fleet = bench_fleet_rows()
     host_after_ms = probe_host()
@@ -691,10 +710,73 @@ def _bench_rows():
         e2e=e2e,
         serve=serve,
         fleet=fleet,
+        smoother=smoother,
         host_after_ms=host_after_ms,
         n_matched=n_matched,
         n_device=n_device,
     )))
+
+
+def bench_smoother_rows(n_pix: int = 16384, windows: int = 8,
+                        n_params: int = 2, reps: int = 5):
+    """Time the jitted RTS backward sweep (``kafka_tpu.smoother``) over
+    a synthetic in-memory chain — ``windows`` checkpoint nodes of
+    ``n_pix`` pixels, every node carrying a forecast sidecar so the
+    measurement is the pure sweep (no propagator re-derivation, no IO).
+    ``device_smoother_px_s`` counts pixel-windows per second.  Failure
+    degrades to null rows with a loud stderr note rather than killing
+    the solve rows."""
+    import datetime
+
+    try:
+        from kafka_tpu.smoother import ChainNode, smooth_chain
+
+        rng = np.random.default_rng(0)
+        idx = np.arange(n_params)
+        base = datetime.datetime(2017, 7, 1)
+        nodes = []
+        for t in range(windows):
+            x = rng.standard_normal(
+                (n_pix, n_params)).astype(np.float32)
+            p_inv = np.zeros((n_pix, n_params, n_params), np.float32)
+            p_inv[:, idx, idx] = \
+                (1.0 + rng.random((n_pix, n_params))).astype(np.float32)
+            sidecar = None
+            if t > 0:
+                xf = rng.standard_normal(
+                    (n_pix, n_params)).astype(np.float32)
+                pf_inv = np.zeros(
+                    (n_pix, n_params, n_params), np.float32)
+                pf_inv[:, idx, idx] = (
+                    0.5 + rng.random((n_pix, n_params))
+                ).astype(np.float32)
+                sidecar = (xf, pf_inv)
+            nodes.append(ChainNode(
+                base + datetime.timedelta(days=4 * t), x, p_inv,
+                sidecar,
+            ))
+        smooth_chain(nodes)  # warm-up: pay the compile outside the reps
+        times = []
+        for _ in range(max(1, reps)):
+            t0 = time.perf_counter()
+            smooth_chain(nodes)
+            times.append(time.perf_counter() - t0)
+        med = float(np.median(times))
+        rows = {
+            "device_smoother_ms": round(med * 1e3, 3),
+            "device_smoother_px_s": round(n_pix * windows / med, 1),
+        }
+        print(
+            f"smoother: {rows['device_smoother_ms']} ms / "
+            f"{windows}x{n_pix} px chain "
+            f"({rows['device_smoother_px_s']} px-windows/s)",
+            file=sys.stderr,
+        )
+        return rows
+    except Exception as exc:  # degrade to null rows: the smoother bench must never cost the solve rows
+        print(f"smoother bench failed ({exc!r}) — smoother rows null",
+              file=sys.stderr)
+        return None
 
 
 def bench_serve_rows(requests: int = 24, concurrency: int = 4):

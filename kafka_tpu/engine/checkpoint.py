@@ -49,6 +49,14 @@ _UNREADABLE_ERRORS = (
 #: ``os.replace`` on a shared fixed-name tmp and commit a torn file.
 _TMP_COUNTER = itertools.count()
 
+#: forecast-sidecar schema version.  The sidecar rides INSIDE the same
+#: per-shard .npz as the analysis (extra keys, never extra files, so the
+#: shard-set completeness rules are unchanged).  Back-compat rule: a set
+#: without the keys, or with a DIFFERENT schema number, simply has no
+#: sidecar — readers fall back to re-deriving the forecast through the
+#: propagator; they never fail the load.
+SIDECAR_SCHEMA = 1
+
 
 def pack_tril(a: np.ndarray) -> np.ndarray:
     """Symmetric ``(..., p, p)`` -> packed lower triangle ``(..., p(p+1)/2)``."""
@@ -92,7 +100,17 @@ class Checkpointer:
         return os.path.join(self.folder, self.prefix + name)
 
     def save(self, timestep: datetime.datetime, x_analysis,
-             p_analysis_inverse) -> List[str]:
+             p_analysis_inverse, x_forecast=None,
+             p_forecast_inverse=None) -> List[str]:
+        """Persist one timestep's analysis (and, optionally, the forecast
+        sidecar the RTS smoother consumes).
+
+        ``x_forecast``/``p_forecast_inverse`` — when BOTH are given — are
+        the window's pre-update forecast state, stored as extra keys in
+        the same shard files (``SIDECAR_SCHEMA``).  The engine only
+        passes them when the forecast was propagated from the PREVIOUS
+        checkpointed analysis (per-window checkpointing), because that
+        adjacency is exactly what the smoother gain assumes."""
         x = np.asarray(x_analysis, self.dtype)
         n_pix = x.shape[0] if x.ndim > 1 else x.size
         if p_analysis_inverse is None:
@@ -102,6 +120,12 @@ class Checkpointer:
             full = np.asarray(p_analysis_inverse)
             p = full.shape[-1]
             tril = pack_tril(full).astype(self.dtype, copy=False)
+        sidecar = x_forecast is not None and p_forecast_inverse is not None
+        if sidecar:
+            xf = np.asarray(x_forecast, self.dtype)
+            f_full = np.asarray(p_forecast_inverse)
+            f_p = f_full.shape[-1]
+            f_tril = pack_tril(f_full).astype(self.dtype, copy=False)
         paths = []
         bounds = np.linspace(0, n_pix, self.n_shards + 1).astype(int)
         for shard in range(self.n_shards):
@@ -117,12 +141,21 @@ class Checkpointer:
             # other's writes, and a crash-leaked tmp is removed by the
             # scheduler's startup sweep (``shard.sweep_stale_tmp``).
             tmp = f"{path}.tmp.{os.getpid()}.{next(_TMP_COUNTER)}"
+            extra = {}
+            if sidecar:
+                extra = dict(
+                    x_forecast=xf[lo:hi],
+                    f_inv_tril=f_tril[lo:hi],
+                    f_p=np.int64(f_p),
+                    sidecar=np.int64(SIDECAR_SCHEMA),
+                )
             with open(tmp, "wb") as f:
                 np.savez_compressed(
                     f,
                     x_analysis=x[lo:hi],
                     p_inv_tril=tril[lo:hi],
                     p=np.int64(p),
+                    **extra,
                 )
                 f.flush()
                 os.fsync(f.fileno())
@@ -229,8 +262,10 @@ class Checkpointer:
         )
 
     @staticmethod
-    def _load_set(paths: List[str]):
+    def _load_set(paths: List[str], with_sidecar: bool = False):
         xs, trils, p = [], [], 0
+        fxs, ftrils, f_p = [], [], 0
+        have_sidecar = True
         for path in paths:
             data = np.load(path)
             xs.append(data["x_analysis"])
@@ -242,6 +277,16 @@ class Checkpointer:
                 if full.size:
                     p = full.shape[-1]
                     trils.append(pack_tril(full))
+            # Forecast sidecar: EVERY shard must carry it under the one
+            # schema this reader knows, else the set has no sidecar
+            # (pre-sidecar sets and future schemas both degrade to the
+            # propagator fallback, never to a load failure).
+            if "sidecar" in data and int(data["sidecar"]) == SIDECAR_SCHEMA:
+                fxs.append(data["x_forecast"])
+                ftrils.append(data["f_inv_tril"])
+                f_p = int(data["f_p"])
+            else:
+                have_sidecar = False
         # Cross-shard consistency: shards written by different runs (or a
         # torn rewrite under a different state layout) must read as
         # corrupt, not silently concatenate into a wrong-shaped state.
@@ -254,10 +299,25 @@ class Checkpointer:
             )
         x = np.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
         if p == 0:
-            return x, None
-        tril = (np.concatenate(trils, axis=0) if len(trils) > 1
-                else trils[0])
-        return x, unpack_tril(tril.astype(np.float32), p)
+            p_inv = None
+        else:
+            tril = (np.concatenate(trils, axis=0) if len(trils) > 1
+                    else trils[0])
+            p_inv = unpack_tril(tril.astype(np.float32), p)
+        if not with_sidecar:
+            return x, p_inv
+        sidecar = None
+        if have_sidecar and fxs and f_p > 0:
+            if len({t.shape[-1] for t in ftrils}) > 1:
+                raise ValueError(
+                    "checkpoint shards disagree on the forecast-sidecar "
+                    f"width: {[t.shape for t in ftrils]}"
+                )
+            xf = np.concatenate(fxs, axis=0) if len(fxs) > 1 else fxs[0]
+            ftril = (np.concatenate(ftrils, axis=0) if len(ftrils) > 1
+                     else ftrils[0])
+            sidecar = (xf, unpack_tril(ftril.astype(np.float32), f_p))
+        return x, p_inv, sidecar
 
     def resume_time_grid(self, time_grid):
         """Trim a time grid to the steps strictly after the last checkpoint.

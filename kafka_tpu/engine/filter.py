@@ -948,18 +948,27 @@ class KalmanFilter:
         return k * aux_bytes <= self._SCAN_MAX_AUX_BYTES
 
     def _maybe_checkpoint(self, checkpointer, timestep, x, p_analysis,
-                          p_inv, n_windows: int, is_last: bool) -> None:
+                          p_inv, n_windows: int, is_last: bool,
+                          forecast=None) -> None:
         """Cadenced checkpoint: counts processed grid windows and saves
         every ``checkpoint_every_n`` (the run's last window always saves).
         A checkpoint asserts "everything up to this timestep is durable",
         so queued async output writes are flushed first; the state is
-        persisted in information form regardless of propagator."""
+        persisted in information form regardless of propagator.
+
+        ``forecast`` is the window's pre-update ``(x_f, p_f, p_f_inv)``
+        triple; it is persisted as the smoother's forecast sidecar ONLY
+        when exactly one window elapsed since the previous save, because
+        the RTS gain pairs a checkpoint's sidecar with the PREVIOUS
+        checkpoint's analysis — with a wider cadence (or a fused block)
+        the smoother re-derives the forecast via the propagator instead."""
         if checkpointer is None:
             return
         self._windows_since_ckpt += n_windows
         if not is_last and \
                 self._windows_since_ckpt < self.checkpoint_every_n:
             return
+        adjacent = n_windows == 1 and self._windows_since_ckpt == 1
         self._windows_since_ckpt = 0
         flush = getattr(self.output, "flush", None)
         if flush is not None:
@@ -969,7 +978,17 @@ class KalmanFilter:
             p_inv_ck = spd_inverse_batched(
                 jnp.asarray(p_analysis, jnp.float32)
             )
-        checkpointer.save(timestep, x, p_inv_ck)
+        x_f = p_f_inv = None
+        if forecast is not None and adjacent:
+            x_f, p_f, p_f_inv = forecast
+            if p_f_inv is None and p_f is not None:
+                p_f_inv = spd_inverse_batched(
+                    jnp.asarray(p_f, jnp.float32)
+                )
+            if x_f is None or p_f_inv is None:
+                x_f = p_f_inv = None
+        checkpointer.save(timestep, x, p_inv_ck, x_forecast=x_f,
+                          p_forecast_inverse=p_f_inv)
 
     def _run_fused_block(self, block, x_analysis, p_analysis,
                          p_analysis_inverse, checkpointer,
@@ -1294,6 +1313,7 @@ class KalmanFilter:
         self._maybe_checkpoint(
             checkpointer, timestep, x_analysis, p_analysis,
             p_analysis_inverse, n_windows=1, is_last=is_last,
+            forecast=(x_forecast, p_forecast, p_forecast_inverse),
         )
         return x_analysis, p_analysis, p_analysis_inverse
 

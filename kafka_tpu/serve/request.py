@@ -3,11 +3,14 @@
 A request is a small JSON object::
 
     {"request_id": "a1b2", "tile": "tile0", "date": "2017-07-05",
-     "deadline_s": 30.0}
+     "deadline_s": 30.0, "smoothed": false}
 
 ``request_id`` must be filesystem-safe (it names the response file);
 ``date`` is the observation date whose analysis the client wants —
-ISO ``YYYY-MM-DD`` or a full isoformat timestamp.  Anything malformed
+ISO ``YYYY-MM-DD`` or a full isoformat timestamp.  ``smoothed=true``
+asks for the REANALYSIS estimate instead: the RTS-smoothed state for
+that date, answered from the tile's checkpoint chain (read-only work —
+any replica sharing the chain can serve it).  Anything malformed
 raises :class:`BadRequest`, which the service converts into a counted
 rejection (a bad request must never crash a daemon that other tenants
 share).
@@ -65,6 +68,9 @@ class ServeRequest:
     #: perf_counter reading at enqueue (process-local, NOT serialised) —
     #: the queue_wait span's start endpoint.
     admitted_perf: Optional[float] = None
+    #: reanalysis request kind: answer with the RTS-smoothed state from
+    #: the checkpoint chain instead of the live filter analysis.
+    smoothed: bool = False
 
     def payload(self) -> dict:
         """The journal line (and the client-visible echo)."""
@@ -75,6 +81,8 @@ class ServeRequest:
             "deadline_s": self.deadline_s,
             "submitted_ts": round(self.submitted_ts, 6),
         }
+        if self.smoothed:
+            out["smoothed"] = True
         if self.admitted_ts is not None:
             out["admitted_ts"] = round(self.admitted_ts, 6)
         return out
@@ -124,6 +132,11 @@ def parse_request(payload, default_tile: Optional[str] = None,
         if deadline_s <= 0:
             raise BadRequest(f"deadline_s must be positive, got "
                              f"{deadline_s}")
+    smoothed = payload.get("smoothed", False)
+    if not isinstance(smoothed, bool):
+        raise BadRequest(
+            f"smoothed must be a JSON boolean, got {smoothed!r}"
+        )
     submitted = payload.get("submitted_ts")
     if not isinstance(submitted, (int, float)):
         submitted = time.time()
@@ -138,4 +151,5 @@ def parse_request(payload, default_tile: Optional[str] = None,
         deadline_s=deadline_s, submitted_ts=float(submitted),
         deadline=deadline, replayed=replayed,
         admitted_ts=None if admitted is None else float(admitted),
+        smoothed=smoothed,
     )

@@ -402,7 +402,10 @@ class AssimilationService:
 
     def _process_traced(self, req: ServeRequest) -> None:
         reg = get_registry()
-        key = (req.tile, req.date.isoformat())
+        # The request KIND is part of the response identity: a smoothed
+        # (reanalysis) answer and the forward analysis for the same
+        # (tile, date) are different products.
+        key = (req.tile, req.date.isoformat(), req.smoothed)
         t_deq = time.perf_counter()
         phases = self._wait_phases(req, t_deq)
         request_log.note_inflight(req.request_id, stage="solving")
@@ -422,7 +425,11 @@ class AssimilationService:
                 "date": req.date.isoformat(),
             }, phases)
             return
-        cached = self._cache.get(key)
+        # A reanalysis answer is a function of the WHOLE chain, and the
+        # chain grows with every forward serve — caching one would pin a
+        # stale smoothed state past the next checkpoint.  Forward
+        # answers are append-only facts; only those are cacheable.
+        cached = None if req.smoothed else self._cache.get(key)
         if cached is not None:
             self._m["cache_hits"].inc()
             body = dict(cached)
@@ -435,7 +442,13 @@ class AssimilationService:
             faults.fault_point(
                 "serve.solve", request=req.request_id, tile=req.tile,
             )
-            return self.sessions[req.tile].serve(req.date)
+            session = self.sessions[req.tile]
+            # Forward requests keep the bare call so any duck-typed
+            # session serves them; only the reanalysis kind requires a
+            # smoother-aware session.
+            if req.smoothed:
+                return session.serve(req.date, smoothed=True)
+            return session.serve(req.date)
 
         try:
             if req.replayed:
@@ -462,10 +475,11 @@ class AssimilationService:
             return
         body = dict(body)
         phases.update(body.pop("trace_phases", {}))
-        self._cache[key] = body
-        self._cache.move_to_end(key)
-        while len(self._cache) > self._cache_size:
-            self._cache.popitem(last=False)
+        if not req.smoothed:
+            self._cache[key] = body
+            self._cache.move_to_end(key)
+            while len(self._cache) > self._cache_size:
+                self._cache.popitem(last=False)
         self._finish_ok(req, body, phases)
 
     def _finish(self, req: ServeRequest, body: dict,
@@ -510,6 +524,7 @@ class AssimilationService:
             phases=trace.get("phases"),
             tile=req.tile, date=req.date.isoformat(),
             served_from=body.get("served_from"),
+            smoothed=req.smoothed or None,
             replayed=req.replayed or None,
             solver_health=body.get("solver_health"),
             quality=body.get("quality"),

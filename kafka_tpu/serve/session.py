@@ -30,6 +30,12 @@ Serve outcomes (the response's ``served_from`` field):
     the request is BEHIND the warm state (a date the warm chain has
     passed).  Served by a throwaway full run up to that date with NO
     checkpointing, so historical reads never rewind the warm chain.
+``smoothed_chain``
+    a ``smoothed=true`` (reanalysis) request: the RTS backward pass
+    over the tile's whole checkpoint chain (``kafka_tpu.smoother``),
+    answered read-only — zero forward windows run, the chain is never
+    rewritten.  The response's ``x_sha256`` matches what the offline
+    ``kafka-smooth`` driver reports for the same chain bit-for-bit.
 """
 
 from __future__ import annotations
@@ -107,15 +113,22 @@ class TileSession:
 
     # -- the serve path -------------------------------------------------
 
-    def serve(self, date: datetime.datetime) -> dict:
+    def serve(self, date: datetime.datetime,
+              smoothed: bool = False) -> dict:
         """Answer one observation-date request; returns the response
-        body (status/served_from/summary fields, JSON-serialisable)."""
+        body (status/served_from/summary fields, JSON-serialisable).
+        ``smoothed=True`` answers with the RTS reanalysis from the
+        checkpoint chain instead of running the forward filter."""
         t0 = time.perf_counter()
         kf, x0, p_inv0, output = self.spec.make_filter()
         # Tile-scoped trace/quality context: the quality ledger keys its
         # sentinel streams by chunk_id, so each tile keeps its own
         # per-band chi^2 series (the serving analogue of a chunk).
         with tracing.push(chunk_id=f"tile:{self.name}"):
+            if smoothed:
+                return self._serve_smoothed_in_context(
+                    kf, output, date, t0,
+                )
             return self._serve_in_context(
                 kf, x0, p_inv0, output, date, t0,
             )
@@ -211,6 +224,102 @@ class TileSession:
             "quality": qual,
         }
 
+    def _serve_smoothed_in_context(self, kf, output, date, t0) -> dict:
+        """The ``smoothed=true`` request kind: run the RTS backward pass
+        over the tile's checkpoint chain and answer with the smoothed
+        state at the grid step covering ``date``.  Strictly read work —
+        the chain is walked, never written (kafkalint rule 19 pins the
+        smoother package to that contract), so any replica sharing the
+        checkpoint directory can serve it.  The fresh filter supplies
+        the trajectory model / uncertainty / propagator the fallback
+        re-derivation needs for pre-sidecar checkpoint sets."""
+        from ..smoother import (
+            QA_CLAMPED, SmootherError, smooth_checkpoints, state_sha256,
+        )
+
+        phases = {}
+        try:
+            target = self.spec.grid_through(date)[-1]
+            t_smooth = time.perf_counter()
+            # The serve_smooth phase joins the request waterfall next to
+            # serve_resume/serve_solve (the smoother's own
+            # smooth_rederive / smooth_sweep spans nest under it).
+            with span("serve_smooth"):
+                try:
+                    result = smooth_checkpoints(
+                        self.checkpointer,
+                        m_matrix=np.asarray(
+                            kf.trajectory_model, np.float32),
+                        q_diag=np.asarray(
+                            kf.trajectory_uncertainty, np.float32),
+                        state_propagator=kf._state_propagator,
+                    )
+                except SmootherError as exc:
+                    raise UnknownDateError(
+                        f"tile {self.name} has no smoothable "
+                        f"checkpoint chain: {exc}"
+                    ) from exc
+                try:
+                    t = result.index_of(target)
+                except KeyError as exc:
+                    raise UnknownDateError(
+                        f"tile {self.name}: grid step "
+                        f"{target.date().isoformat()} is not in the "
+                        "warm checkpoint chain — serve the date "
+                        "forward first, then request the reanalysis"
+                    ) from exc
+            phases["smooth_ms"] = (time.perf_counter() - t_smooth) * 1e3
+        finally:
+            close = getattr(output, "close", None)
+            if close is not None:
+                close()
+        x_t = np.asarray(result.x_smoothed[t], np.float32)
+        qa_t = np.asarray(result.qa[t])
+        n_valid = kf.gather.n_valid
+        shrink = result.sigma_shrink(t)
+        quality.get_ledger().record_smoothed(
+            target.date().isoformat(), shrink, n_valid=int(n_valid),
+            prefix=f"tile:{self.name}",
+        )
+        self.serves += 1
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        self._record("smoothed_chain", 0, wall_ms)
+        return {
+            "trace_phases": {k: round(v, 3) for k, v in phases.items()},
+            "status": "ok",
+            "tile": self.name,
+            "date": date.isoformat(),
+            "smoothed": True,
+            # The chain step actually answered (the grid point covering
+            # the requested observation date, like the forward path).
+            "timestep": target.isoformat(),
+            "served_from": "smoothed_chain",
+            "windows_run": 0,
+            "windows_smoothed": len(result.timesteps),
+            "rederived": len(result.rederived),
+            "skipped": len(result.skipped),
+            "n_pixels": int(n_valid),
+            "x_mean": [round(float(v), 7)
+                       for v in x_t[:n_valid].mean(axis=0)],
+            # Digest over ALL stored rows — the same bytes the offline
+            # kafka-smooth driver hashes, so served and offline
+            # reanalysis compare bit-for-bit.
+            "x_sha256": state_sha256(x_t),
+            "wall_ms": round(wall_ms, 3),
+            # The backward pass has no innovations: quality scores on
+            # sigma-shrink (smoothed/filter posterior width) instead of
+            # chi^2, the same verdict quality_report recomputes.
+            "quality": {
+                "verdict": quality.smoothed_verdict_for(shrink),
+                "sigma_shrink": [
+                    None if not np.isfinite(v) else round(float(v), 6)
+                    for v in shrink
+                ],
+                "clamped_px": int(np.count_nonzero(qa_t & QA_CLAMPED)),
+                "rederived_step": result.timesteps[t] in result.rederived,
+            },
+        }
+
     def _quality(self, kf) -> dict:
         """The run's quality summary from the engine's diagnostics log
         (the verdicts were computed by the quality ledger during the
@@ -256,7 +365,8 @@ class TileSession:
             )
         reg.counter(
             "kafka_serve_solves_total",
-            "tile serves by path (cold / warm / warm_noop / cold_replay)",
+            "tile serves by path (cold / warm / warm_noop / "
+            "cold_replay / smoothed_chain)",
         ).inc(served_from=served_from)
         reg.counter(
             "kafka_serve_windows_run_total",

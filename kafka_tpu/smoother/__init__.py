@@ -1,0 +1,43 @@
+"""Reanalysis: fixed-interval RTS smoothing over the checkpoint chain.
+
+The forward filter conditions every date on the PAST only, so mid-series
+uncertainties are strictly worse than what the full series supports.
+This package runs the Rauch–Tung–Striebel backward recursion over the
+per-timestep analysis states the :class:`~kafka_tpu.engine.Checkpointer`
+already persists — near-zero new I/O — and turns the same run into a
+reanalysis product: ``kafka-smooth`` (offline driver) and the
+``smoothed=true`` serve request kind both answer from it.
+
+The smoother is strictly READ-ONLY over the chain (kafkalint rule
+``forward-state-mutation-in-smoother`` enforces this statically): it
+loads checkpoint sets, never writes them.  See BASELINE.md "Reanalysis
+smoother".
+"""
+
+from .rts_pass import (
+    QA_CLAMPED,
+    QA_REDERIVED,
+    QA_SMOOTHED,
+    QA_TERMINAL,
+    ChainNode,
+    SmootherError,
+    SmootherResult,
+    load_chain,
+    smooth_chain,
+    smooth_checkpoints,
+    state_sha256,
+)
+
+__all__ = [
+    "QA_CLAMPED",
+    "QA_REDERIVED",
+    "QA_SMOOTHED",
+    "QA_TERMINAL",
+    "ChainNode",
+    "SmootherError",
+    "SmootherResult",
+    "load_chain",
+    "smooth_chain",
+    "smooth_checkpoints",
+    "state_sha256",
+]

@@ -1,0 +1,357 @@
+"""The RTS backward pass over a checkpoint chain.
+
+Recursion (information form — ``p_analysis_inverse`` is what the chain
+stores; covariances only ever exist as batched per-pixel ``p x p``
+inverses on device):
+
+    P_a(t)   = P_a_inv(t)^-1
+    G(t)     = P_a(t) M^T P_f_inv(t+1)
+    x_s(t)   = x_a(t) + G(t) (x_s(t+1) - x_f(t+1))
+    P_s(t)   = P_a(t) + G(t) (P_s(t+1) - P_f(t+1)) G(t)^T
+
+anchored at the newest analysis: ``x_s(T) = x_a(T)``,
+``P_s(T) = P_a_inv(T)^-1`` — so the final date is bit-identical to the
+filter by construction.  The per-pixel step is vmapped over the pixel
+axis and driven by a reverse ``jax.lax.scan``, one jitted program for
+the whole sweep (same compilation-cache/pjit path as the forward
+filter's fused scan).
+
+The forecast pair ``(x_f(t+1), P_f_inv(t+1))`` comes from the
+checkpoint's forecast sidecar when present (``checkpoint.SIDECAR_SCHEMA``)
+and is otherwise re-derived by running the configured propagator forward
+from the previous analysis — exact whenever the forward run used the
+same propagator with no date-varying prior, and the documented
+approximation that bridges corrupt or pre-sidecar sets.
+
+Reported uncertainty stays in the filter's convention
+(``sigma = 1/sqrt(diag(P_inv))``).  Smoothing can only add information
+(``P_s <= P_a`` in the Loewner order, so ``diag(P_s_inv) >=
+diag(P_a_inv)``); the smoothed information diagonal is clamped to the
+filter's from below at output time so float32 roundoff can never report
+a smoothed sigma LARGER than the filter's — the clamp restores a
+mathematically guaranteed invariant and never touches the mean.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import hashlib
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.propagators import propagate_information_filter
+from ..engine.checkpoint import _UNREADABLE_ERRORS, Checkpointer
+from ..telemetry import get_registry
+from ..telemetry.tracing import trace_span
+
+#: smoother QA bitmask (the ``dump_qa`` twin for the backward pass;
+#: 0 outside the state mask, like the forward solver-QA band).
+QA_SMOOTHED = 1    #: pixel carries a smoothed value
+QA_CLAMPED = 2     #: sigma clamped at the filter floor (f32 roundoff)
+QA_REDERIVED = 4   #: forecast re-derived via the propagator (no sidecar)
+QA_TERMINAL = 8    #: newest date: smoothed == analysis by construction
+
+
+class SmootherError(RuntimeError):
+    """The chain cannot support a smoothing pass (empty, no information
+    matrices, or sidecar-less with no propagator configuration)."""
+
+
+@dataclasses.dataclass
+class ChainNode:
+    """One intact checkpoint set, loaded: the analysis state plus the
+    optional forecast sidecar ``(x_forecast, p_forecast_inverse)``."""
+
+    timestep: datetime.datetime
+    x_analysis: np.ndarray
+    p_analysis_inverse: Optional[np.ndarray]
+    sidecar: Optional[Tuple[np.ndarray, np.ndarray]] = None
+
+
+@dataclasses.dataclass
+class SmootherResult:
+    """The backward pass, oldest first: smoothed means, smoothed
+    marginal information diagonals (filter sigma convention), per-pixel
+    QA bitmasks, and the dates whose forecast had to be re-derived."""
+
+    timesteps: List[datetime.datetime]
+    x_smoothed: np.ndarray          # (T, n, p)
+    p_inv_diag: np.ndarray          # (T, n, p) smoothed marginal info
+    p_inv_diag_filter: np.ndarray   # (T, n, p) the FILTER's, for QA
+    qa: np.ndarray                  # (T, n) uint8 bitmask
+    rederived: List[datetime.datetime]
+    skipped: List[datetime.datetime]
+
+    def index_of(self, timestep: datetime.datetime) -> int:
+        for i, ts in enumerate(self.timesteps):
+            if ts == timestep:
+                return i
+        raise KeyError(f"{timestep} not in smoothed chain")
+
+    def sigma_shrink(self, t: int) -> List[float]:
+        """Per-parameter mean ``sigma_smoothed / sigma_filter`` at step
+        ``t`` over pixels carrying information — <= 1 for a correct
+        pass (the quality-ledger signal for smoothed records)."""
+        f = self.p_inv_diag_filter[t]
+        s = self.p_inv_diag[t]
+        out = []
+        for k in range(f.shape[-1]):
+            ok = np.isfinite(f[:, k]) & np.isfinite(s[:, k]) \
+                & (f[:, k] > 0) & (s[:, k] > 0)
+            if not ok.any():
+                out.append(float("nan"))
+                continue
+            out.append(float(np.mean(
+                np.sqrt(f[ok, k] / s[ok, k])
+            )))
+        return out
+
+
+def state_sha256(x: np.ndarray) -> str:
+    """Digest of a smoothed state plane — over ALL stored pixel rows
+    (the chain's layout), so the offline driver and the serve path hash
+    the same bytes without either knowing the other's pixel mask."""
+    return hashlib.sha256(
+        np.ascontiguousarray(np.asarray(x, np.float32)).tobytes()
+    ).hexdigest()
+
+
+def load_chain(checkpointer: Checkpointer,
+               shard: Optional[int] = None) -> Tuple[List[ChainNode],
+                                                     List[datetime.datetime]]:
+    """Walk the chain newest -> oldest with ``load_latest``'s corruption
+    fallback semantics — an unreadable, incomplete or shape-inconsistent
+    set is skipped with the same logged event/counter and the walk
+    continues — then return the intact nodes OLDEST first plus the
+    skipped timesteps (the recursion bridges them via the propagator)."""
+    nodes: List[ChainNode] = []
+    skipped: List[datetime.datetime] = []
+    for ts, paths, strays in reversed(checkpointer._scan_sets()):
+        if paths is None:
+            checkpointer._note_unreadable(
+                ts, strays,
+                "incomplete shard set (missing shard files)",
+            )
+            skipped.append(ts)
+            continue
+        use = [paths[shard]] if shard is not None else paths
+        try:
+            x, p_inv, sidecar = checkpointer._load_set(
+                use, with_sidecar=True
+            )
+        except _UNREADABLE_ERRORS as exc:
+            checkpointer._note_unreadable(ts, use, repr(exc)[:300])
+            skipped.append(ts)
+            continue
+        nodes.append(ChainNode(ts, x, p_inv, sidecar))
+    nodes.reverse()
+    skipped.reverse()
+    return nodes, skipped
+
+
+def _pixel_step(x_a, p_a_inv, x_f, p_f_inv, x_s_next, p_s_next, m_matrix):
+    """One pixel's backward update — vmapped over the pixel axis."""
+    p_a = jnp.linalg.inv(p_a_inv)
+    gain = p_a @ m_matrix.T @ p_f_inv
+    x_s = x_a + gain @ (x_s_next - x_f)
+    p_f = jnp.linalg.inv(p_f_inv)
+    p_s = p_a + gain @ (p_s_next - p_f) @ gain.T
+    # Symmetrise against accumulated roundoff: the recursion preserves
+    # symmetry exactly, float32 does not.
+    return x_s, 0.5 * (p_s + p_s.T)
+
+
+@partial(jax.jit, static_argnames=())
+def _rts_sweep(x_a, p_a_inv, x_f_next, p_f_inv_next, m_matrix,
+               x_anchor, p_anchor_inv):
+    """The whole backward pass as one program: reverse ``lax.scan`` over
+    the stacked steps ``t = 0..T-2`` (oldest first), carry anchored at
+    the newest analysis.  Returns the smoothed means and the smoothed
+    marginal INFORMATION diagonals for those steps."""
+    step = jax.vmap(_pixel_step,
+                    in_axes=(0, 0, 0, 0, 0, 0, None))
+
+    def body(carry, inp):
+        x_s_next, p_s_next = carry
+        xa, pa_inv, xf, pf_inv = inp
+        x_s, p_s = step(xa, pa_inv, xf, pf_inv, x_s_next, p_s_next,
+                        m_matrix)
+        return (x_s, p_s), (x_s, p_s)
+
+    p_anchor = jax.vmap(jnp.linalg.inv)(p_anchor_inv)
+    _, (xs, ps) = jax.lax.scan(
+        body, (x_anchor, p_anchor),
+        (x_a, p_a_inv, x_f_next, p_f_inv_next), reverse=True,
+    )
+    # Marginal sigma in the filter's convention needs diag(P_s^-1):
+    # one more batched inverse over the stacked smoothed covariances.
+    ps_inv = jax.vmap(jax.vmap(jnp.linalg.inv))(ps)
+    diag_s = jnp.diagonal(ps_inv, axis1=-2, axis2=-1)
+    diag_a = jnp.diagonal(p_a_inv, axis1=-2, axis2=-1)
+    # Smoothing adds information; clamp restores the invariant under
+    # float32 roundoff (QA records where it engaged).
+    clamped = jnp.any(diag_s < diag_a, axis=-1)
+    return xs, jnp.maximum(diag_s, diag_a), clamped
+
+
+def _derive_forecast(node: ChainNode, m_matrix, q_diag,
+                     state_propagator):
+    """Propagator fallback: the forecast at ``t+1`` re-derived from the
+    analysis at ``t`` — what the forward run computed, when it used the
+    same propagator and no date-varying prior."""
+    x_f, p_f, p_f_inv = state_propagator(
+        jnp.asarray(node.x_analysis, jnp.float32), None,
+        jnp.asarray(node.p_analysis_inverse, jnp.float32),
+        m_matrix, q_diag,
+    )
+    if p_f_inv is None:
+        p_f_inv = jax.vmap(jnp.linalg.inv)(p_f)
+    return np.asarray(x_f), np.asarray(p_f_inv)
+
+
+def smooth_chain(nodes: Sequence[ChainNode],
+                 m_matrix: Optional[np.ndarray] = None,
+                 q_diag: Optional[np.ndarray] = None,
+                 state_propagator=propagate_information_filter,
+                 skipped: Sequence[datetime.datetime] = (),
+                 ) -> SmootherResult:
+    """Run the fixed-interval RTS recursion over loaded chain nodes
+    (oldest first).  ``m_matrix`` defaults to identity (the reference's
+    trajectory model); ``q_diag``/``state_propagator`` configure the
+    fallback used wherever a node carries no forecast sidecar."""
+    nodes = list(nodes)
+    if not nodes:
+        raise SmootherError("checkpoint chain is empty")
+    for node in nodes:
+        if node.p_analysis_inverse is None:
+            raise SmootherError(
+                f"checkpoint {node.timestep} carries no information "
+                "matrix; the smoother gain needs the analysis in "
+                "information form"
+            )
+    p = nodes[0].x_analysis.shape[-1]
+    widths = {n.x_analysis.shape for n in nodes}
+    if len(widths) > 1:
+        raise SmootherError(
+            f"chain nodes disagree on the state shape: {sorted(widths)}"
+        )
+    m = (jnp.eye(p, dtype=jnp.float32) if m_matrix is None
+         else jnp.asarray(m_matrix, jnp.float32))
+    reg = get_registry()
+    rederived: List[datetime.datetime] = []
+    timesteps = [n.timestep for n in nodes]
+
+    if len(nodes) == 1:
+        only = nodes[0]
+        diag = np.ascontiguousarray(np.diagonal(
+            only.p_analysis_inverse, axis1=-2, axis2=-1), np.float32)
+        qa = np.full((1, only.x_analysis.shape[0]),
+                     QA_SMOOTHED | QA_TERMINAL, np.uint8)
+        return SmootherResult(
+            timesteps, only.x_analysis[None].astype(np.float32),
+            diag[None], diag[None].copy(), qa, rederived, list(skipped),
+        )
+
+    # Forecast at t+1 for every pair (t, t+1): sidecar when present,
+    # propagator fallback otherwise.  A sidecar is NOT usable across a
+    # bridged gap (a skipped corrupt set between the pair): it was
+    # propagated from the skipped analysis, not from ``prev`` — the
+    # propagator bridge re-derives from the surviving neighbour instead.
+    x_f_next, p_f_inv_next = [], []
+    for prev, node in zip(nodes[:-1], nodes[1:]):
+        gap = any(prev.timestep < ts < node.timestep for ts in skipped)
+        if node.sidecar is not None and not gap:
+            x_f, p_f_inv = node.sidecar
+        else:
+            if q_diag is None or state_propagator is None:
+                raise SmootherError(
+                    f"checkpoint {node.timestep} has no forecast "
+                    "sidecar; pass q_diag (and the forward run's "
+                    "propagator) so the smoother can re-derive it"
+                )
+            with trace_span("smooth_rederive",
+                            timestep=str(node.timestep)):
+                x_f, p_f_inv = _derive_forecast(
+                    prev, m, jnp.asarray(q_diag, jnp.float32),
+                    state_propagator,
+                )
+            rederived.append(node.timestep)
+        x_f_next.append(np.asarray(x_f, np.float32))
+        p_f_inv_next.append(np.asarray(p_f_inv, np.float32))
+
+    last = nodes[-1]
+    with trace_span("smooth_sweep", windows=len(nodes)):
+        xs, diag_s, clamped = _rts_sweep(
+            jnp.asarray(np.stack([n.x_analysis for n in nodes[:-1]]),
+                        jnp.float32),
+            jnp.asarray(
+                np.stack([n.p_analysis_inverse for n in nodes[:-1]]),
+                jnp.float32),
+            jnp.asarray(np.stack(x_f_next), jnp.float32),
+            jnp.asarray(np.stack(p_f_inv_next), jnp.float32),
+            m,
+            jnp.asarray(last.x_analysis, jnp.float32),
+            jnp.asarray(last.p_analysis_inverse, jnp.float32),
+        )
+    xs = np.asarray(xs)
+    diag_s = np.asarray(diag_s)
+    clamped = np.asarray(clamped)
+
+    n_pix = last.x_analysis.shape[0]
+    t_total = len(nodes)
+    x_out = np.empty((t_total, n_pix, p), np.float32)
+    d_out = np.empty((t_total, n_pix, p), np.float32)
+    qa = np.full((t_total, n_pix), QA_SMOOTHED, np.uint8)
+    x_out[:-1] = xs
+    d_out[:-1] = diag_s
+    qa[:-1][clamped] |= QA_CLAMPED
+    # Newest date: EXACT passthrough of the filter analysis (never
+    # routed through inv(inv(.)) — the bit-identity pin).
+    x_out[-1] = np.asarray(last.x_analysis, np.float32)
+    d_out[-1] = np.ascontiguousarray(np.diagonal(
+        last.p_analysis_inverse, axis1=-2, axis2=-1), np.float32)
+    qa[-1] |= QA_TERMINAL
+    for ts in rederived:
+        qa[timesteps.index(ts)] |= QA_REDERIVED
+    d_filter = np.stack([
+        np.ascontiguousarray(np.diagonal(
+            n.p_analysis_inverse, axis1=-2, axis2=-1), np.float32)
+        for n in nodes
+    ])
+
+    reg.counter(
+        "kafka_smoother_windows_total",
+        "checkpointed windows smoothed by RTS backward passes",
+    ).inc(t_total)
+    if rederived:
+        reg.counter(
+            "kafka_smoother_rederived_total",
+            "smoothed windows whose forecast had no sidecar and was "
+            "re-derived through the propagator",
+        ).inc(len(rederived))
+    reg.emit(
+        "smooth_pass", windows=t_total,
+        rederived=len(rederived), skipped=len(skipped),
+        newest=str(last.timestep),
+    )
+    return SmootherResult(timesteps, x_out, d_out, d_filter, qa,
+                          rederived, list(skipped))
+
+
+def smooth_checkpoints(checkpointer: Checkpointer,
+                       m_matrix: Optional[np.ndarray] = None,
+                       q_diag: Optional[np.ndarray] = None,
+                       state_propagator=propagate_information_filter,
+                       shard: Optional[int] = None) -> SmootherResult:
+    """``load_chain`` + ``smooth_chain`` in one call — the entry point
+    both ``kafka-smooth`` and the ``smoothed=true`` serve path use, so
+    their outputs are the SAME jitted program over the same bytes."""
+    nodes, skipped = load_chain(checkpointer, shard=shard)
+    return smooth_chain(nodes, m_matrix=m_matrix, q_diag=q_diag,
+                        state_propagator=state_propagator,
+                        skipped=skipped)

@@ -109,6 +109,13 @@ CUSUM_H_LOW = 3.5
 #: bias big enough that the un-absorbed residual still departs by an
 #: order of magnitude.
 OBS_BIAS_VALUE = 0.25
+#: tolerance on the smoother's per-parameter sigma-shrink ratio
+#: (``mean(sigma_smoothed / sigma_filter)``).  Smoothing can only add
+#: information, so the ratio is <= 1 by construction (the RTS pass
+#: clamps float32 roundoff); a ratio above 1 + tol means the backward
+#: pass is reporting LESS certainty than the filter it conditions on —
+#: a broken reanalysis, scored OVERCONFIDENT.
+SMOOTH_SHRINK_TOL = 1e-3
 # -- end of the sanctioned threshold block ----------------------------------
 
 #: verdict vocabulary (severity order for :func:`worst_verdict`).
@@ -156,6 +163,20 @@ def verdict_for(chi2_per_band: Sequence[float],
         return OVERCONFIDENT
     if min(values) < lo:
         return UNDERCONFIDENT
+    return CONSISTENT
+
+
+def smoothed_verdict_for(sigma_shrink: Sequence[float],
+                         tol: float = SMOOTH_SHRINK_TOL) -> str:
+    """The reanalysis verdict for one smoothed window's per-parameter
+    sigma-shrink ratios: any finite ratio above ``1 + tol`` means the
+    smoothed sigma exceeds the filter's (impossible for a correct RTS
+    pass) -> OVERCONFIDENT; no finite signal -> NO_OBS."""
+    ratios = _finite_ratios(sigma_shrink)
+    if not ratios:
+        return NO_OBS
+    if max(v for _, v in ratios) > 1.0 + tol:
+        return OVERCONFIDENT
     return CONSISTENT
 
 
@@ -325,11 +346,14 @@ class QualityLedger:
                       n_valid: int,
                       solver_health: Optional[dict] = None,
                       prefix: Optional[str] = None,
-                      fused: Optional[int] = None) -> dict:
+                      fused: Optional[int] = None,
+                      smoothed: bool = False) -> dict:
         """Land one assimilated window in the ledger.  ``chi2_per_band``
         is the engine's normalised per-band innovation chi^2 (already on
         the host via the packed diagnostic read — this call adds zero
-        device transfers).  Returns the appended record."""
+        device transfers).  ``smoothed`` marks reanalysis-pass records
+        (``quality_report`` scores the passes separately).  Returns the
+        appended record."""
         ratios = [round(float(v), 6) for v in chi2_per_band]
         verdict = verdict_for(ratios, self.lo, self.hi)
         with self._lock:
@@ -360,11 +384,42 @@ class QualityLedger:
                 "verdict": verdict,
                 "solver_health": solver_health,
                 "fused": fused,
+                "smoothed": bool(smoothed),
                 "drift": {
                     "active": bool(drift_bands),
                     "bands": drift_bands,
                     "state": states,
                 },
+            })
+            n_drifting = len(self._drifting)
+        self._publish(rec, n_drifting)
+        return rec
+
+    def record_smoothed(self, date, sigma_shrink: Sequence[float],
+                        n_valid: int,
+                        prefix: Optional[str] = None) -> dict:
+        """Land one REANALYSIS window: the RTS smoother's per-parameter
+        sigma-shrink ratios (``mean(sigma_smoothed / sigma_filter)``,
+        <= 1 for a correct pass) take the place of innovation chi^2 —
+        the backward pass never touches observations, so it has no
+        innovations to score.  Smoothed records never feed the drift
+        sentinels (those watch the FORWARD filter's consistency)."""
+        shrink = [round(float(v), 6) for v in sigma_shrink]
+        with self._lock:
+            rec = self._append_locked({
+                "schema": LEDGER_SCHEMA,
+                "ts": round(time.time(), 6),
+                "date": str(date),
+                "prefix": prefix,
+                "degraded": False,
+                "chi2_per_band": [],
+                "sigma_shrink": shrink,
+                "n_valid": int(n_valid),
+                "verdict": smoothed_verdict_for(shrink),
+                "solver_health": None,
+                "fused": None,
+                "smoothed": True,
+                "drift": {"active": False, "bands": [], "state": []},
             })
             n_drifting = len(self._drifting)
         self._publish(rec, n_drifting)

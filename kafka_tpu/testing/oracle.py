@@ -136,3 +136,44 @@ def blend_prior_np(prior_mean, prior_inv_blocks, x_forecast, p_inv_blocks):
     ).astype(np.float32)
     lu = spl.splu(a.tocsc())
     return lu.solve(b), a
+
+
+def rts_smoother_np(
+    x_analysis: np.ndarray,
+    p_analysis_inverse: np.ndarray,
+    x_forecast: np.ndarray,
+    p_forecast_inverse: np.ndarray,
+    m_matrix: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Dense float64 fixed-interval RTS smoother oracle, per pixel.
+
+    Textbook covariance-form backward recursion over T filter steps:
+    ``G(t) = P_a(t) M^T P_f(t+1)^-1``,
+    ``x_s(t) = x_a(t) + G(t)(x_s(t+1) - x_f(t+1))``,
+    ``P_s(t) = P_a(t) + G(t)(P_s(t+1) - P_f(t+1))G(t)^T``,
+    anchored at ``x_s(T-1) = x_a(T-1)``.  Inputs are stacked
+    ``(T, n, p)`` / ``(T, n, p, p)`` in INFORMATION form (what the
+    checkpoint chain stores); ``x_forecast``/``p_forecast_inverse`` hold
+    the forecast AT each step (index 0 is unused by the recursion).
+    Returns ``(x_smoothed, p_smoothed)`` stacked the same way — the
+    executable spec the jitted ``smoother.rts_pass`` sweep is pinned
+    against in the linear regime.
+    """
+    t_total, n_pix, p = x_analysis.shape
+    x_s = np.empty((t_total, n_pix, p), np.float64)
+    p_s = np.empty((t_total, n_pix, p, p), np.float64)
+    m = np.asarray(m_matrix, np.float64)
+    p_a = np.linalg.inv(np.asarray(p_analysis_inverse, np.float64))
+    p_f = np.linalg.inv(np.asarray(p_forecast_inverse, np.float64))
+    x_s[-1] = x_analysis[-1]
+    p_s[-1] = p_a[-1]
+    for t in range(t_total - 2, -1, -1):
+        for i in range(n_pix):
+            gain = p_a[t, i] @ m.T @ np.linalg.inv(p_f[t + 1, i])
+            x_s[t, i] = x_analysis[t, i] + gain @ (
+                x_s[t + 1, i] - x_forecast[t + 1, i]
+            )
+            p_s[t, i] = p_a[t, i] + gain @ (
+                p_s[t + 1, i] - p_f[t + 1, i]
+            ) @ gain.T
+    return x_s, p_s

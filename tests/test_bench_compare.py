@@ -248,6 +248,75 @@ class TestServeRowGating:
         assert "UNJUDGEABLE" in capsys.readouterr().err
 
 
+def smoother_artifact(ms=12.5, px_s=1.0e7, smoothed_p99=35.0, **kw):
+    art = artifact(**kw)
+    art.update({
+        "device_smoother_ms": ms,
+        "device_smoother_px_s": px_s,
+        "serve_smoothed_p99_ms": smoothed_p99,
+    })
+    return art
+
+
+class TestSmootherRowGating:
+    """The reanalysis rows gate: device_smoother_ms via the device_*_ms
+    pattern, serve_smoothed_p99_ms like the forward serving rows, and
+    device_smoother_px_s with the regression direction INVERTED
+    (throughput — larger is better)."""
+
+    def test_smoother_ms_gates_via_device_pattern(self):
+        bc = _load()
+        regressions, _ = bc.compare_rows(
+            smoother_artifact(), smoother_artifact(ms=12.5 * 1.5)
+        )
+        assert len(regressions) == 1
+        assert "device_smoother_ms" in regressions[0]
+
+    def test_smoothed_p99_gates(self):
+        bc = _load()
+        regressions, _ = bc.compare_rows(
+            smoother_artifact(), smoother_artifact(smoothed_p99=60.0)
+        )
+        assert len(regressions) == 1
+        assert "serve_smoothed_p99_ms" in regressions[0]
+
+    def test_px_s_drop_is_a_regression(self):
+        """Throughput FALLING by more than the threshold gates — the
+        direction device_*_ms gating would read as an improvement."""
+        bc = _load()
+        regressions, _ = bc.compare_rows(
+            smoother_artifact(px_s=1.0e7),
+            smoother_artifact(px_s=0.8e7),
+        )
+        assert len(regressions) == 1
+        assert "device_smoother_px_s" in regressions[0]
+
+    def test_px_s_rise_is_an_improvement(self):
+        bc = _load()
+        regressions, lines = bc.compare_rows(
+            smoother_artifact(px_s=1.0e7),
+            smoother_artifact(px_s=1.5e7),
+        )
+        assert regressions == []
+        assert any("device_smoother_px_s" in ln and "improved" in ln
+                   for ln in lines)
+
+    def test_disappeared_px_s_row_gates(self, tmp_path):
+        bc = _load()
+        old = write(tmp_path, "old.json", smoother_artifact())
+        gone = smoother_artifact()
+        gone["device_smoother_px_s"] = None  # failed-smoother-bench null
+        new = write(tmp_path, "new.json", gone)
+        assert bc.main([old, new]) == 1
+
+    def test_old_artifact_without_smoother_rows_unaffected(self,
+                                                           tmp_path):
+        bc = _load()
+        old = write(tmp_path, "old.json", artifact())
+        new = write(tmp_path, "new.json", smoother_artifact())
+        assert bc.main([old, new]) == 0
+
+
 def health_artifact(quarantined=0, cap=0, **kw):
     art = artifact(**kw)
     art["solver_health"] = {

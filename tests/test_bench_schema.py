@@ -24,10 +24,12 @@ EXPECTED_KEYS = [
     "device_pallas_ms", "device_pallas_ms_spread", "device_pallas_px_s",
     "device_pallas_fused_lin_ms", "device_pallas_fused_lin_ms_spread",
     "device_pallas_fused_lin_px_s",
+    "device_smoother_ms", "device_smoother_px_s",
     "e2e_pixel_steps_per_s", "e2e_pixel_steps_per_s_spread",
     "e2e_device_fraction", "e2e_n_pixels",
     "serve_p50_ms", "serve_p99_ms", "serve_cold_ms",
     "serve_rejected_total", "serve_requests_total",
+    "serve_smoothed_p50_ms", "serve_smoothed_p99_ms",
     "serve_trace_coverage", "serve_slowest_ms",
     "live_telemetry",
     "serve_fleet_p50_ms", "serve_fleet_p99_ms", "serve_fleet_replicas",
@@ -51,6 +53,8 @@ SERVE_ROWS = {
     "serve_rejected_total": 0, "serve_requests_total": 24,
     "serve_ok_total": 24, "serve_cancelled_total": 0,
     "serve_error_total": 0,
+    "serve_smoothed_p50_ms": 9.0, "serve_smoothed_p99_ms": 35.0,
+    "serve_smoothed_ok_total": 6,
     "serve_trace_coverage": 1.0, "serve_slowest_ms": 25.5,
     "serve_slo_alerts_total": 0, "serve_slo_budget_remaining": 1.0,
     "live_telemetry": {
@@ -73,8 +77,15 @@ FLEET_ROWS = {
 }
 
 
+#: a bench.bench_smoother_rows dict, as the reanalysis bench emits it.
+SMOOTHER_ROWS = {
+    "device_smoother_ms": 12.5,
+    "device_smoother_px_s": 1.05e7,
+}
+
+
 def _assemble(reg, host_after_ms=0.3, serve=SERVE_ROWS,
-              fleet=FLEET_ROWS):
+              fleet=FLEET_ROWS, smoother=SMOOTHER_ROWS):
     health = bench.probe_health(retry_wait_s=0.0, registry=reg)
     return health, bench.assemble_result(
         health,
@@ -86,6 +97,7 @@ def _assemble(reg, host_after_ms=0.3, serve=SERVE_ROWS,
         e2e=(5.0e4, 0.55, 7212, 1.2e4),
         serve=serve,
         fleet=fleet,
+        smoother=smoother,
         host_after_ms=host_after_ms,
         registry=reg,
     )
@@ -293,6 +305,24 @@ class TestBenchArtifactSchema:
         assert result["serve_fleet_p50_ms"] is None
         assert result["serve_fleet_p99_ms"] is None
         assert result["serve_fleet_rerouted_total"] is None
+
+    def test_smoother_rows_flow_through(self):
+        """The reanalysis rows (bench_smoother_rows + the loadgen
+        --smoothed mix) land verbatim; a run without them degrades to
+        null (device_smoother_ms / serve_smoothed_p99_ms disappearance
+        then gates in bench_compare)."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            _, result = _assemble(reg)
+        assert result["device_smoother_ms"] == 12.5
+        assert result["device_smoother_px_s"] == 1.05e7
+        assert result["serve_smoothed_p50_ms"] == 9.0
+        assert result["serve_smoothed_p99_ms"] == 35.0
+        with telemetry.use(MetricsRegistry()) as reg:
+            _, result = _assemble(reg, serve=None, smoother=None)
+        assert result["device_smoother_ms"] is None
+        assert result["device_smoother_px_s"] is None
+        assert result["serve_smoothed_p50_ms"] is None
+        assert result["serve_smoothed_p99_ms"] is None
 
     def test_live_telemetry_flows_through(self):
         """The mid-run /metrics scrape series (tools/loadgen) lands

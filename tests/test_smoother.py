@@ -1,0 +1,547 @@
+"""Reanalysis subsystem (ISSUE 17): the RTS smoother over the
+checkpoint chain and the ``smoothed=true`` request kind.
+
+Acceptance pins:
+
+- chain-walk regression: ``list_checkpoints``/``_scan_sets`` are
+  chronological regardless of save order or shard count, and the
+  newest->oldest walk skips corrupt/incomplete sets with the same
+  counted fallback ``load_latest`` uses — including a corrupt NEWEST
+  set (the smoother anchors one set earlier, exactly like resume);
+- smoother parity: the newest date is BIT-IDENTICAL to the filter
+  analysis, mid-series smoothed sigma is pixelwise <= the filter's,
+  and the jitted sweep matches the dense float64 NumPy RTS oracle in
+  the identity-operator linear regime;
+- pre-sidecar compatibility: checkpoint sets saved without the
+  forecast sidecar still resume the filter AND feed the smoother via
+  the propagator fallback (``rederived`` populated, never a failure);
+- serving: a ``smoothed=true`` response from the warm chain equals the
+  offline ``kafka-smooth`` output bit-for-bit (the shared
+  ``state_sha256`` digest), smoothed answers are never cached, and the
+  quality ledger/report score the reanalysis pass separately.
+
+All tier-1 / CPU.
+"""
+
+import datetime
+import os
+import time
+
+import numpy as np
+import pytest
+
+from kafka_tpu import telemetry
+from kafka_tpu.core import propagate_information_filter
+from kafka_tpu.engine import Checkpointer
+from kafka_tpu.engine.checkpoint import SIDECAR_SCHEMA, pack_tril
+from kafka_tpu.serve import (
+    AssimilationService,
+    BadRequest,
+    TileSession,
+    make_synthetic_tile,
+    parse_request,
+    read_response,
+    synthetic_dates,
+)
+from kafka_tpu.serve.session import UnknownDateError
+from kafka_tpu.serve.synthetic import DEFAULT_BASE_DATE
+from kafka_tpu.smoother import (
+    QA_REDERIVED,
+    QA_SMOOTHED,
+    QA_TERMINAL,
+    ChainNode,
+    SmootherError,
+    load_chain,
+    smooth_chain,
+    smooth_checkpoints,
+    state_sha256,
+)
+from kafka_tpu.telemetry import MetricsRegistry, quality
+from kafka_tpu.testing.oracle import rts_smoother_np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the default synthetic tile's observation calendar.
+DATES = synthetic_dates(DEFAULT_BASE_DATE, 16, 2)
+
+
+def day(i):
+    return datetime.datetime(2017, 7, 1) + datetime.timedelta(days=i)
+
+
+def _spd(rng, n_pix, p):
+    """Batch of well-conditioned SPD information matrices."""
+    a = rng.normal(size=(n_pix, p, p))
+    return (np.einsum("nij,nkj->nik", a, a)
+            + 3.0 * np.eye(p)).astype(np.float64)
+
+
+def _save_states(ck, timesteps, n_pix=6, p=2, seed=0, sidecar=False):
+    """Save one deterministic analysis state per timestep; returns the
+    per-timestep ``(x, p_inv)`` pairs keyed by timestep."""
+    rng = np.random.default_rng(seed)
+    saved = {}
+    for ts in timesteps:
+        x = rng.normal(size=(n_pix, p)).astype(np.float32)
+        p_inv = _spd(rng, n_pix, p).astype(np.float32)
+        extra = {}
+        if sidecar:
+            extra = dict(
+                x_forecast=rng.normal(size=(n_pix, p)).astype(np.float32),
+                p_forecast_inverse=_spd(rng, n_pix, p).astype(np.float32),
+            )
+        ck.save(ts, x, p_inv, **extra)
+        saved[ts] = (x, p_inv, extra or None)
+        # mtime separation so the most-recently-written-set-wins rule
+        # in _scan_sets is deterministic on coarse-mtime filesystems
+        time.sleep(0.01)
+    return saved
+
+
+# ---------------------------------------------------------------------------
+# Satellite: chain-walk ordering regression (the smoother's foundation)
+# ---------------------------------------------------------------------------
+
+class TestChainWalkOrdering:
+    def test_list_checkpoints_chronological_regardless_of_save_order(
+            self, tmp_path):
+        ck = Checkpointer(str(tmp_path))
+        _save_states(ck, [day(9), day(1), day(5)])
+        assert [ts for ts, _ in ck.list_checkpoints()] == \
+            [day(1), day(5), day(9)]
+        assert [ts for ts, _, _ in ck._scan_sets()] == \
+            [day(1), day(5), day(9)]
+
+    def test_multi_shard_sets_are_chronological_and_complete(
+            self, tmp_path):
+        ck = Checkpointer(str(tmp_path), n_shards=3)
+        _save_states(ck, [day(5), day(1), day(9)], n_pix=9)
+        listed = ck.list_checkpoints()
+        assert [ts for ts, _ in listed] == [day(1), day(5), day(9)]
+        for _, paths in listed:
+            assert len(paths) == 3
+            # shard files in shard order, never lexicographic accident
+            assert [f"shard{k}of3" in os.path.basename(q)
+                    for k, q in enumerate(paths)] == [True] * 3
+
+    def test_reverse_scan_is_the_newest_first_walk(self, tmp_path):
+        """``load_latest`` and ``load_chain`` both walk
+        ``reversed(_scan_sets())`` — pin that this IS newest-first."""
+        ck = Checkpointer(str(tmp_path))
+        _save_states(ck, [day(1), day(5), day(9)])
+        walked = [ts for ts, _, _ in reversed(ck._scan_sets())]
+        assert walked == [day(9), day(5), day(1)]
+
+    def test_load_chain_skips_corrupt_newest_and_anchors_earlier(
+            self, tmp_path):
+        """The smoother's corrupt-NEWEST fallback, in reverse of the
+        resume test: truncate one shard of the newest set; the chain
+        anchors at the previous intact set, the skipped timestep is
+        reported, and the unreadable counter fires once."""
+        ck = Checkpointer(str(tmp_path), n_shards=2)
+        _save_states(ck, [day(1), day(5), day(9)], n_pix=8)
+        newest_paths = ck.list_checkpoints()[-1][1]
+        with open(newest_paths[0], "r+b") as f:
+            f.truncate(40)
+        with telemetry.use(MetricsRegistry()) as reg:
+            nodes, skipped = load_chain(ck)
+            assert reg.value("kafka_checkpoint_unreadable_total") == 1
+        assert [n.timestep for n in nodes] == [day(1), day(5)]
+        assert skipped == [day(9)]
+        # load_latest agrees: the same set anchors a resume.
+        latest = ck.load_latest()
+        assert latest is not None and latest[0] == day(5)
+        np.testing.assert_array_equal(latest[1], nodes[-1].x_analysis)
+
+    def test_load_chain_skips_incomplete_middle_set(self, tmp_path):
+        """A missing shard (crash between shard writes) in the MIDDLE of
+        the chain: the walk bridges it, surviving neighbours intact."""
+        ck = Checkpointer(str(tmp_path), n_shards=2)
+        saved = _save_states(ck, [day(1), day(5), day(9)], n_pix=8)
+        middle_paths = ck.list_checkpoints()[1][1]
+        os.remove(middle_paths[1])
+        with telemetry.use(MetricsRegistry()) as reg:
+            nodes, skipped = load_chain(ck)
+            assert reg.value("kafka_checkpoint_unreadable_total") == 1
+        assert [n.timestep for n in nodes] == [day(1), day(9)]
+        assert skipped == [day(5)]
+        np.testing.assert_array_equal(
+            nodes[1].x_analysis, saved[day(9)][0]
+        )
+
+
+# ---------------------------------------------------------------------------
+# Sidecar schema: roundtrip, pre-sidecar compatibility, unknown schema
+# ---------------------------------------------------------------------------
+
+class TestForecastSidecar:
+    def test_sidecar_roundtrips_through_sharded_sets(self, tmp_path):
+        ck = Checkpointer(str(tmp_path), n_shards=2)
+        saved = _save_states(ck, [day(1), day(5)], n_pix=8,
+                             sidecar=True)
+        nodes, skipped = load_chain(ck)
+        assert skipped == []
+        for node in nodes:
+            assert node.sidecar is not None
+            xf, pf_inv = node.sidecar
+            want = saved[node.timestep][2]
+            np.testing.assert_array_equal(xf, want["x_forecast"])
+            np.testing.assert_array_equal(
+                pf_inv, want["p_forecast_inverse"]
+            )
+
+    def test_pre_sidecar_sets_resume_and_smooth_via_fallback(
+            self, tmp_path):
+        """The back-compat acceptance pin: sets saved WITHOUT the
+        sidecar (the pre-ISSUE-17 writer) still resume the filter and
+        still smooth — every pair re-derived through the propagator,
+        never a load failure."""
+        ck = Checkpointer(str(tmp_path))
+        _save_states(ck, [day(1), day(5), day(9)])
+        assert ck.load_latest() is not None  # the filter resumes
+        nodes, _ = load_chain(ck)
+        assert all(n.sidecar is None for n in nodes)
+        # No fallback configuration -> a diagnosable error, not garbage.
+        with pytest.raises(SmootherError, match="no forecast sidecar"):
+            smooth_chain(nodes)
+        with telemetry.use(MetricsRegistry()) as reg:
+            result = smooth_checkpoints(ck, q_diag=np.float32(1e-3))
+            assert reg.value("kafka_smoother_rederived_total") == 2
+        assert result.rederived == [day(5), day(9)]
+        assert bool(np.all(result.qa[1] & QA_REDERIVED))
+        # The newest-date passthrough holds on the fallback path too.
+        assert bool(np.all(result.qa[-1] & QA_TERMINAL))
+
+    def test_unknown_sidecar_schema_degrades_to_no_sidecar(
+            self, tmp_path):
+        """A FUTURE schema number must read as "no sidecar" (propagator
+        fallback), never as a load failure."""
+        ck = Checkpointer(str(tmp_path))
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(4, 2)).astype(np.float32)
+        p_inv = _spd(rng, 4, 2).astype(np.float32)
+        path = os.path.join(str(tmp_path), "state_20170701T000000.npz")
+        np.savez_compressed(
+            path, x_analysis=x, p_inv_tril=pack_tril(p_inv),
+            p=np.int64(2), x_forecast=x,
+            f_inv_tril=pack_tril(p_inv), f_p=np.int64(2),
+            sidecar=np.int64(SIDECAR_SCHEMA + 41),
+        )
+        nodes, skipped = load_chain(ck)
+        assert skipped == []
+        assert len(nodes) == 1 and nodes[0].sidecar is None
+        np.testing.assert_array_equal(nodes[0].x_analysis, x)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: smoother parity pins
+# ---------------------------------------------------------------------------
+
+def _simulate_linear_filter(t_total=5, n_pix=6, p=3, seed=7):
+    """A consistent identity-operator linear Kalman filter in float64:
+    the regime where the RTS recursion's invariants hold exactly, so
+    the jitted sweep can be pinned against the dense oracle."""
+    rng = np.random.default_rng(seed)
+    q = np.array([1e-2, 5e-3, 2e-2])[:p]
+    r_inv = 4.0
+    x_a = rng.normal(size=(n_pix, p))
+    p_a_inv = _spd(rng, n_pix, p)
+    xs_a, ps_a_inv = [x_a], [p_a_inv]
+    xs_f = [np.zeros((n_pix, p))]
+    ps_f_inv = [np.stack([np.eye(p)] * n_pix)]
+    for _ in range(t_total - 1):
+        p_f = np.linalg.inv(p_a_inv) + np.diag(q)
+        p_f_inv = np.linalg.inv(p_f)
+        x_f = x_a.copy()  # M = I
+        y = x_f + rng.normal(size=(n_pix, p)) * 0.3
+        p_a_inv = p_f_inv + r_inv * np.eye(p)
+        rhs = np.einsum("nij,nj->ni", p_f_inv, x_f) + r_inv * y
+        x_a = np.linalg.solve(p_a_inv, rhs[..., None])[..., 0]
+        xs_a.append(x_a)
+        ps_a_inv.append(p_a_inv)
+        xs_f.append(x_f)
+        ps_f_inv.append(p_f_inv)
+    return (np.stack(xs_a), np.stack(ps_a_inv),
+            np.stack(xs_f), np.stack(ps_f_inv))
+
+
+class TestSmootherParity:
+    def test_sweep_matches_dense_numpy_oracle(self):
+        """Identity-operator linear regime: the jitted float32 sweep
+        against ``rts_smoother_np`` (dense float64) on the SAME
+        float32-rounded inputs."""
+        x_a, p_a_inv, x_f, p_f_inv = _simulate_linear_filter()
+        x_a32 = x_a.astype(np.float32)
+        pa32 = p_a_inv.astype(np.float32)
+        xf32 = x_f.astype(np.float32)
+        pf32 = p_f_inv.astype(np.float32)
+        nodes = [
+            ChainNode(day(1 + 4 * t), x_a32[t], pa32[t],
+                      sidecar=(xf32[t], pf32[t]) if t else None)
+            for t in range(len(x_a32))
+        ]
+        result = smooth_chain(nodes)
+        assert result.rederived == []
+        x_oracle, p_oracle = rts_smoother_np(
+            x_a32.astype(np.float64), pa32.astype(np.float64),
+            xf32.astype(np.float64), pf32.astype(np.float64),
+            np.eye(x_a32.shape[-1]),
+        )
+        np.testing.assert_allclose(
+            result.x_smoothed, x_oracle, rtol=1e-3, atol=1e-4
+        )
+        diag_oracle = np.diagonal(
+            np.linalg.inv(p_oracle), axis1=-2, axis2=-1
+        )
+        np.testing.assert_allclose(
+            result.p_inv_diag, diag_oracle, rtol=2e-3
+        )
+
+    def test_final_date_bit_identical_and_sigma_never_larger(self):
+        x_a, p_a_inv, x_f, p_f_inv = _simulate_linear_filter(seed=11)
+        x_a32 = x_a.astype(np.float32)
+        pa32 = p_a_inv.astype(np.float32)
+        nodes = [
+            ChainNode(day(1 + 4 * t), x_a32[t], pa32[t],
+                      sidecar=(x_f[t].astype(np.float32),
+                               p_f_inv[t].astype(np.float32))
+                      if t else None)
+            for t in range(len(x_a32))
+        ]
+        result = smooth_chain(nodes)
+        # Newest date: EXACT passthrough of the filter analysis.
+        np.testing.assert_array_equal(result.x_smoothed[-1], x_a32[-1])
+        assert bool(np.all(result.qa[-1] & QA_TERMINAL))
+        assert bool(np.all(result.qa & QA_SMOOTHED))
+        # Smoothing adds information: pixelwise, every date, every param.
+        assert bool(np.all(
+            result.p_inv_diag >= result.p_inv_diag_filter
+        ))
+        # ...which the ledger signal and verdict reflect mid-series.
+        for t in range(len(nodes) - 1):
+            shrink = result.sigma_shrink(t)
+            assert all(v <= 1.0 + 1e-3 for v in shrink if np.isfinite(v))
+            assert quality.smoothed_verdict_for(shrink) == \
+                quality.CONSISTENT
+
+    def test_rederived_forecast_matches_sidecar_from_same_propagator(
+            self):
+        """When the sidecar was produced by the same propagator the
+        fallback re-runs, stripping the sidecars changes NOTHING: the
+        bridge is exact, down to the bit."""
+        import jax.numpy as jnp
+
+        x_a, p_a_inv, _, _ = _simulate_linear_filter(seed=13)
+        x_a32 = x_a.astype(np.float32)
+        pa32 = p_a_inv.astype(np.float32)
+        p = x_a32.shape[-1]
+        q = np.full(p, 1e-3, np.float32)
+        nodes = [ChainNode(day(1), x_a32[0], pa32[0])]
+        for t in range(1, len(x_a32)):
+            xf, _, pf_inv = propagate_information_filter(
+                jnp.asarray(x_a32[t - 1]), None,
+                jnp.asarray(pa32[t - 1]),
+                jnp.eye(p, dtype=jnp.float32), jnp.asarray(q),
+            )
+            nodes.append(ChainNode(
+                day(1 + 4 * t), x_a32[t], pa32[t],
+                sidecar=(np.asarray(xf), np.asarray(pf_inv)),
+            ))
+        with_sidecar = smooth_chain(nodes)
+        stripped = [ChainNode(n.timestep, n.x_analysis,
+                              n.p_analysis_inverse) for n in nodes]
+        rederived = smooth_chain(stripped, q_diag=q)
+        assert with_sidecar.rederived == []
+        assert rederived.rederived == [n.timestep for n in nodes[1:]]
+        np.testing.assert_array_equal(
+            with_sidecar.x_smoothed, rederived.x_smoothed
+        )
+        np.testing.assert_array_equal(
+            with_sidecar.p_inv_diag, rederived.p_inv_diag
+        )
+
+    def test_single_node_chain_is_the_analysis(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 2)).astype(np.float32)
+        p_inv = _spd(rng, 4, 2).astype(np.float32)
+        result = smooth_chain([ChainNode(day(1), x, p_inv)])
+        np.testing.assert_array_equal(result.x_smoothed[0], x)
+        assert bool(np.all(result.qa[0] & QA_TERMINAL))
+
+    def test_real_chain_newest_equals_filter_analysis(self, tmp_path):
+        """Over a REAL forward run's chain (sidecars written by the
+        engine): the smoothed newest date is bit-identical to the
+        checkpointed filter analysis, and no pair needs the fallback."""
+        with telemetry.use(MetricsRegistry()):
+            sess = TileSession(make_synthetic_tile(
+                "t", str(tmp_path / "ck")))
+            sess.serve(DATES[6])
+            result = smooth_checkpoints(sess.checkpointer)
+        assert result.rederived == [] and result.skipped == []
+        ts, x_latest, p_inv_latest = sess.checkpointer.load_latest()
+        assert result.timesteps[-1] == ts
+        np.testing.assert_array_equal(
+            result.x_smoothed[-1], np.asarray(x_latest, np.float32)
+        )
+        np.testing.assert_array_equal(
+            result.p_inv_diag[-1],
+            np.diagonal(p_inv_latest, axis1=-2, axis2=-1).astype(
+                np.float32),
+        )
+        assert bool(np.all(
+            result.p_inv_diag >= result.p_inv_diag_filter
+        ))
+
+
+# ---------------------------------------------------------------------------
+# The smoothed=true request kind (serve path + offline CLI parity)
+# ---------------------------------------------------------------------------
+
+def _await_response(root, rid, timeout=120.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        got = read_response(root, rid)
+        if got is not None:
+            return got
+        time.sleep(0.05)
+    raise AssertionError(f"no response for {rid} within {timeout}s")
+
+
+class TestSmoothedServe:
+    def test_smoothed_flag_parses_and_rejects_non_bool(self):
+        req = parse_request({
+            "tile": "t", "date": "2017-07-05", "smoothed": True,
+        })
+        assert req.smoothed is True
+        assert req.payload()["smoothed"] is True
+        base = parse_request({"tile": "t", "date": "2017-07-05"})
+        assert base.smoothed is False
+        assert "smoothed" not in base.payload()
+        with pytest.raises(BadRequest, match="smoothed"):
+            parse_request({
+                "tile": "t", "date": "2017-07-05", "smoothed": "yes",
+            })
+
+    def test_serve_matches_offline_cli_bit_identical(self, tmp_path):
+        """THE acceptance pin: the warm-chain smoothed response and the
+        offline ``kafka-smooth`` run report the same ``state_sha256``
+        for the same date — the same jitted program over the same
+        checkpoint bytes."""
+        from kafka_tpu.cli import kafka_smooth
+
+        with telemetry.use(MetricsRegistry()):
+            sess = TileSession(make_synthetic_tile(
+                "t", str(tmp_path / "ck")))
+            sess.serve(DATES[6])
+            body = sess.serve(DATES[4], smoothed=True)
+        assert body["served_from"] == "smoothed_chain"
+        assert body["smoothed"] is True
+        assert body["windows_run"] == 0  # read work, no forward windows
+        assert body["quality"]["verdict"] == quality.CONSISTENT
+
+        with telemetry.use(MetricsRegistry()):
+            summary = kafka_smooth.main([
+                "--ckpt-dir", str(tmp_path / "ck"),
+                "--ny", "20", "--nx", "20",
+                "--propagator", "approx", "--q", "1e-3",
+                "--outdir", str(tmp_path / "out"),
+            ])
+        assert "failed" not in summary
+        assert summary["windows"] == body["windows_smoothed"]
+        assert summary["dates"][body["timestep"]]["x_sha256"] == \
+            body["x_sha256"]
+        # The product set landed: per-date smoothed mean + sigma planes
+        # and the QA twin.
+        names = os.listdir(str(tmp_path / "out"))
+        assert summary["outputs_written"] > 0
+        assert any(n.endswith("_smoothed.tif") for n in names)
+        assert any(n.endswith("_smoothed_unc.tif") for n in names)
+        assert any(n.startswith("solver_qa_") for n in names)
+
+    def test_smoothed_requests_route_but_are_never_cached(
+            self, tmp_path):
+        """Through the full service: a smoothed request is routable
+        read work, its response carries the reanalysis identity, and a
+        repeat is re-solved (never answered from the response cache) —
+        while the forward answer for the same tile IS cached."""
+        with telemetry.use(MetricsRegistry()):
+            spec = make_synthetic_tile("t", str(tmp_path / "ck"))
+            svc = AssimilationService(
+                {"t": TileSession(spec)}, str(tmp_path)
+            ).start()
+            try:
+                svc.submit({"request_id": "fwd0", "tile": "t",
+                            "date": DATES[6].isoformat()})
+                assert _await_response(
+                    str(tmp_path), "fwd0")["status"] == "ok"
+                for rid in ("rs1", "rs2"):
+                    svc.submit({"request_id": rid, "tile": "t",
+                                "date": DATES[4].isoformat(),
+                                "smoothed": True})
+                r1 = _await_response(str(tmp_path), "rs1")
+                r2 = _await_response(str(tmp_path), "rs2")
+                svc.submit({"request_id": "fwd1", "tile": "t",
+                            "date": DATES[6].isoformat()})
+                fwd_again = _await_response(str(tmp_path), "fwd1")
+            finally:
+                svc.close()
+        assert r1["status"] == "ok" and r1["smoothed"] is True
+        assert r1["served_from"] == "smoothed_chain"
+        # The repeat re-solved from the chain — not "cache".
+        assert r2["served_from"] == "smoothed_chain"
+        assert r1["x_sha256"] == r2["x_sha256"]
+        # Forward caching is untouched by the new kind.
+        assert fwd_again["served_from"] == "cache"
+
+    def test_smoothed_without_chain_or_beyond_it_is_unknown_date(
+            self, tmp_path):
+        with telemetry.use(MetricsRegistry()):
+            sess = TileSession(make_synthetic_tile(
+                "t", str(tmp_path / "ck")))
+            with pytest.raises(UnknownDateError,
+                               match="no smoothable checkpoint chain"):
+                sess.serve(DATES[4], smoothed=True)
+            sess.serve(DATES[2])
+            with pytest.raises(UnknownDateError,
+                               match="serve the date forward first"):
+                sess.serve(DATES[6], smoothed=True)
+
+    def test_state_sha256_is_layout_stable(self):
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        assert state_sha256(x) == state_sha256(x[::1].copy())
+        assert state_sha256(x) == state_sha256(
+            np.asarray(x, np.float64))  # cast-stable: hashes f32 bytes
+        assert state_sha256(x) != state_sha256(x + 1)
+
+
+# ---------------------------------------------------------------------------
+# Quality: the reanalysis pass is scored on its own timeline
+# ---------------------------------------------------------------------------
+
+class TestSmoothedQuality:
+    def test_ledger_and_report_score_passes_separately(self, tmp_path):
+        import tools.quality_report as qr
+
+        with telemetry.use(MetricsRegistry()):
+            ledger = quality.QualityLedger(directory=str(tmp_path))
+            ledger.record_window(
+                "2017-07-05", [1.0, 1.1], n_valid=9, prefix="tile:t",
+            )
+            ledger.record_smoothed(
+                "2017-07-05", [0.8, 0.9], n_valid=9, prefix="tile:t",
+            )
+            ledger.record_smoothed(
+                "2017-07-09", [1.4, 0.9], n_valid=9, prefix="tile:t",
+            )
+        report = qr.build_report([os.path.join(str(tmp_path),
+                                               "quality.jsonl")])
+        tiles = report["tiles"]
+        assert set(tiles) == {"tile:t", "tile:t [smoothed]"}
+        smoothed = tiles["tile:t [smoothed]"]["dates"]
+        assert [d["verdict"] for d in smoothed] == \
+            [quality.CONSISTENT, quality.OVERCONFIDENT]
+        # Recomputed from the ledger alone (self-containment pin): the
+        # sigma-shrink scoring reproduces the recorded verdicts.
+        assert all(d["recomputed"] == d["verdict"] for d in smoothed)
+        forward = tiles["tile:t"]["dates"]
+        assert [d["verdict"] for d in forward] == [quality.CONSISTENT]

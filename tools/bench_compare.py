@@ -55,7 +55,12 @@ from typing import Dict, List, Optional, Tuple
 #: informational (cold start is setup; rejections and re-routes are
 #: policy outcomes, not latencies).
 GATED_ROW_PATTERNS = ("device_*_ms", "serve_p50_ms", "serve_p99_ms",
-                      "serve_fleet_p50_ms", "serve_fleet_p99_ms")
+                      "serve_fleet_p50_ms", "serve_fleet_p99_ms",
+                      "serve_smoothed_p99_ms")
+#: gated throughput rows (LARGER is better): the reanalysis sweep's
+#: pixel-windows/s.  Same disappearance rule; the regression direction
+#: is inverted.
+GATED_THROUGHPUT_PATTERNS = ("device_smoother_px_s",)
 DEVICE_ROW_PATTERN = GATED_ROW_PATTERNS[0]  # back-compat alias
 
 
@@ -64,7 +69,8 @@ def device_rows(artifact: dict) -> Dict[str, float]:
     off-TPU — are dropped; spreads are diagnostics, not gates)."""
     return {
         k: float(v) for k, v in artifact.items()
-        if any(fnmatch.fnmatch(k, pat) for pat in GATED_ROW_PATTERNS)
+        if any(fnmatch.fnmatch(k, pat) for pat in
+               GATED_ROW_PATTERNS + GATED_THROUGHPUT_PATTERNS)
         and not k.endswith("_spread")
         and isinstance(v, (int, float))
     }
@@ -87,11 +93,16 @@ def compare_rows(old: dict, new: dict, threshold: float = 0.10,
     lines: List[str] = []
     for key in sorted(set(rows_old) | set(rows_new)):
         a, b = rows_old.get(key), rows_new.get(key)
+        larger_better = any(
+            fnmatch.fnmatch(key, pat)
+            for pat in GATED_THROUGHPUT_PATTERNS
+        )
+        unit = "px/s" if larger_better else "ms"
         if a is not None and b is None:
             regressions.append(
-                f"{key}: {a:.3f} ms -> MISSING (row disappeared from the "
-                "new artifact — a dropped measurement gates like a "
-                "regression)"
+                f"{key}: {a:.3f} {unit} -> MISSING (row disappeared "
+                "from the new artifact — a dropped measurement gates "
+                "like a regression)"
             )
             lines.append(f"  {key}: {a:.3f} -> MISSING  REGRESSION")
             continue
@@ -100,17 +111,21 @@ def compare_rows(old: dict, new: dict, threshold: float = 0.10,
                          "artifact — skipped")
             continue
         delta = (b - a) / a if a else 0.0
+        # "worse" is the gate's direction: more milliseconds, or fewer
+        # pixel-windows per second.
+        worse = -delta if larger_better else delta
         verdict = "ok"
-        if delta > threshold:
+        if worse > threshold:
             verdict = "REGRESSION"
             regressions.append(
-                f"{key}: {a:.3f} -> {b:.3f} ms (+{100 * delta:.1f}% "
-                f"> {100 * threshold:.0f}%)"
+                f"{key}: {a:.3f} -> {b:.3f} {unit} "
+                f"({100 * delta:+.1f}%, worse by more than "
+                f"{100 * threshold:.0f}%)"
             )
-        elif delta < -threshold:
+        elif worse < -threshold:
             verdict = "improved"
         lines.append(
-            f"  {key}: {a:.3f} -> {b:.3f} ms ({100 * delta:+.1f}%) "
+            f"  {key}: {a:.3f} -> {b:.3f} {unit} ({100 * delta:+.1f}%) "
             f"{verdict}"
         )
     if not rows_old or not rows_new:

@@ -5,6 +5,8 @@ concurrent tile requests at a serving target, measures per-request
 submit-to-response wall time, and emits the BENCH JSON serving rows —
 
     serve_p50_ms / serve_p99_ms   latency percentiles over OK responses
+    serve_smoothed_p50/p99_ms     same, over smoothed=true (reanalysis)
+                                  requests when --smoothed mixes them in
     serve_rejected_total          requests shed at admission
     (+ serve_ok/cancelled/error/requests totals and serve_cold_ms, the
      one cold-start solve paid before the timed phase)
@@ -204,6 +206,7 @@ def run_load(
             payload = dict(payload)
             payload.setdefault("request_id", f"load{i:05d}")
             base_id = payload["request_id"]
+            is_smoothed = bool(payload.get("smoothed"))
             t0 = time.perf_counter()
             backoffs = 0
             while True:
@@ -234,7 +237,7 @@ def run_load(
                         backoff_total[0] += backoffs
                         results.append(
                             ("rejected", rejected.get("reason"),
-                             0.0, None, None)
+                             0.0, None, None, is_smoothed)
                         )
                     break
                 wall_ms = (time.perf_counter() - t0) * 1e3
@@ -254,7 +257,8 @@ def run_load(
                 with lock:
                     backoff_total[0] += backoffs
                     results.append(
-                        (status, None, wall_ms, covered, server_ms)
+                        (status, None, wall_ms, covered, server_ms,
+                         is_smoothed)
                     )
                     for key, v in health.items():
                         health_totals[key] = \
@@ -274,26 +278,36 @@ def run_load(
     for t in threads:
         t.join()
     wall_s = time.perf_counter() - t_start
-    ok_lat = [w for s, _, w, _, _ in results if s == "ok"]
+    # Forward and reanalysis latencies are DIFFERENT products under the
+    # same roof: serve_p50/p99 keep meaning "forward analysis latency"
+    # even when --smoothed mixes reanalysis reads into the load.
+    ok_lat = [w for s, _, w, _, _, sm in results
+              if s == "ok" and not sm]
+    smoothed_lat = [w for s, _, w, _, _, sm in results
+                    if s == "ok" and sm]
     p50, p99 = _percentiles(ok_lat)
-    count = lambda s: sum(1 for st, _, _, _, _ in results if st == s)
+    smoothed_p50, smoothed_p99 = _percentiles(smoothed_lat)
+    count = lambda s: sum(1 for st, _, _, _, _, _ in results if st == s)
     n_ok = count("ok")
     # Tracing-coverage rows (ISSUE 14): the fraction of OK requests
     # whose named spans explain their server-side wall time, and the
     # slowest single request — the exemplar tools/trace_report.py
     # breaks down.
-    covs = [c for s, _, _, c, _ in results if s == "ok" and
+    covs = [c for s, _, _, c, _, _ in results if s == "ok" and
             c is not None]
     trace_coverage = (
         round(sum(1 for c in covs if c) / len(covs), 4)
         if covs else None
     )
     slowest = [sm if sm is not None else w
-               for s, _, w, _, sm in results if s == "ok"]
+               for s, _, w, _, sm, _ in results if s == "ok"]
     slowest_ms = round(max(slowest), 3) if slowest else None
     return {
         "serve_p50_ms": p50,
         "serve_p99_ms": p99,
+        "serve_smoothed_p50_ms": smoothed_p50,
+        "serve_smoothed_p99_ms": smoothed_p99,
+        "serve_smoothed_ok_total": len(smoothed_lat),
         "serve_requests_total": len(results),
         "serve_ok_total": n_ok,
         "serve_rejected_total": count("rejected"),
@@ -321,15 +335,21 @@ def run_load(
     }
 
 
-def synthetic_request_plan(dates, tiles, n_requests: int) -> List[dict]:
+def synthetic_request_plan(dates, tiles, n_requests: int,
+                           smoothed_every: int = 0) -> List[dict]:
     """A deterministic request mix cycling tiles x dates (newest date
-    most often — the interactive-traffic shape the warm path serves)."""
+    most often — the interactive-traffic shape the warm path serves).
+    ``smoothed_every=K`` turns every Kth request into a ``smoothed=true``
+    reanalysis read of the same tile/date (0 disables)."""
     plan = []
     for i in range(n_requests):
         tile = tiles[i % len(tiles)]
         # Bias 3:1 towards the newest date; the rest walk the ladder.
         date = dates[-1] if i % 4 else dates[i % len(dates)]
-        plan.append({"tile": tile, "date": date.isoformat()})
+        req = {"tile": tile, "date": date.isoformat()}
+        if smoothed_every and i % smoothed_every == smoothed_every - 1:
+            req["smoothed"] = True
+        plan.append(req)
     return plan
 
 
@@ -339,6 +359,7 @@ def bench_serve(
     concurrency: int = 4,
     tiles: int = 1,
     warm: bool = True,
+    smoothed_every: int = 4,
 ) -> dict:
     """Self-contained serving bench (the ``bench.py`` embed): build an
     in-process service over synthetic tiles, pay the cold start outside
@@ -385,8 +406,13 @@ def bench_serve(
             cold_ms = round((time.perf_counter() - t0) * 1e3, 3)
             if rows["serve_ok_total"] != len(sessions):
                 raise RuntimeError(f"serve warm-up failed: {rows}")
+        # The default mix folds reanalysis reads in (every 4th request
+        # asks smoothed=true): the warm-up above built the checkpoint
+        # chain those reads answer from, so the serve_smoothed_* rows
+        # measure the chain-walk+RTS path, not a cold failure.
         plan = synthetic_request_plan(
-            dates[-4:], sorted(sessions), requests
+            dates[-4:], sorted(sessions), requests,
+            smoothed_every=smoothed_every,
         )
         scraper = _MetricsScraper(httpd.url).start()
         # SLO ride-along (kafka_tpu.telemetry.slo): a fast-windowed
@@ -553,6 +579,11 @@ def main(argv=None) -> int:
                     help="honor retry_after_s rejection hints with up "
                          "to K backoff waits per request (counted into "
                          "serve_backoff_total)")
+    ap.add_argument("--smoothed", type=int, default=0, metavar="K",
+                    help="every Kth request asks for the RTS reanalysis "
+                         "(smoothed=true) instead of the forward "
+                         "analysis — emits the serve_smoothed_* rows "
+                         "(0 disables; synthetic mode defaults to 4)")
     ap.add_argument("--tiles", default="tile0",
                     help="comma-separated tile names (--root mode)")
     ap.add_argument("--dates", default=None,
@@ -581,7 +612,8 @@ def main(argv=None) -> int:
         else:
             dates = synthetic_dates(DEFAULT_BASE_DATE, 16, 2)
         tiles = [t.strip() for t in args.tiles.split(",") if t.strip()]
-        plan = synthetic_request_plan(dates, tiles, args.requests)
+        plan = synthetic_request_plan(dates, tiles, args.requests,
+                                      smoothed_every=args.smoothed)
         if args.deadline_s:
             for p in plan:
                 p["deadline_s"] = args.deadline_s
@@ -610,6 +642,7 @@ def main(argv=None) -> int:
                 rows = bench_serve(
                     tmp, requests=args.requests,
                     concurrency=args.concurrency,
+                    smoothed_every=args.smoothed or 4,
                 )
         finally:
             shutil.rmtree(tmp, ignore_errors=True)

@@ -61,10 +61,13 @@ def find_ledgers(paths: List[str]) -> List[str]:
 
 def _tile_key(rec: dict, source: str) -> str:
     """Group records by tile/chunk prefix, falling back to the ledger's
-    parent directory name for prefix-less (single-run) ledgers."""
-    return rec.get("prefix") or os.path.basename(
+    parent directory name for prefix-less (single-run) ledgers.
+    Reanalysis (``smoothed``) records get their own timeline per tile so
+    the forward filter and the RTS pass are scored separately."""
+    key = rec.get("prefix") or os.path.basename(
         os.path.dirname(os.path.abspath(source))
     ) or "-"
+    return f"{key} [smoothed]" if rec.get("smoothed") else key
 
 
 def _deviation(rec: dict) -> float:
@@ -107,9 +110,14 @@ def build_report(paths: List[str], worst_n: int = 5) -> dict:
                 "verdict": rec.get("verdict"),
                 # Re-derived from the ratios alone: the ledger must be
                 # self-contained (acceptance: the report reproduces
-                # per-date verdicts with no live process).
+                # per-date verdicts with no live process).  Smoothed
+                # records score on sigma-shrink instead of chi^2 (the
+                # backward pass has no innovations).
                 "recomputed": (
                     quality.NO_OBS if rec.get("degraded")
+                    else quality.smoothed_verdict_for(
+                        [float(v) for v in rec.get("sigma_shrink") or ()]
+                    ) if rec.get("smoothed")
                     else quality.verdict_for(ratios)
                 ),
                 "degraded": bool(rec.get("degraded")),
