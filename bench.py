@@ -542,6 +542,40 @@ def assemble_result(
         # (the stable disabled shape when no evaluator ran), diffed
         # informationally by tools/bench_compare.py.
         "slo": slo_snapshot(reg),
+        # Compact DEVICE-plane snapshot (BASELINE.md "Device-plane
+        # observability"): top kernels / collective fraction from the
+        # newest parsed profiler capture plus the HBM peak watermark —
+        # so the artifact records WHERE device time went, not just how
+        # much; tools/bench_compare.py warns LOUDLY when the
+        # collective fraction grows.
+        "device_profile": devprof_snapshot(reg),
+    }
+
+
+def devprof_snapshot(registry=None) -> dict:
+    """The run's device-plane state (``telemetry.devprof``): capture
+    count, the top-kernel table (bounded), bucket totals, collective
+    fraction, and the per-device HBM peak from the watermark gauges —
+    always present (zeros/None before any capture or watermark)."""
+    from kafka_tpu.telemetry import devprof as _devprof
+
+    reg = registry if registry is not None else get_registry()
+    ks = _devprof.kernel_summary(reg, n=8)
+    hbm_peak = {}
+    for key, val in reg.flat().items():
+        if key.startswith("kafka_device_memory_peak_bytes"):
+            hbm_peak[key] = val
+    return {
+        "captures_parsed": ks["captures_parsed"],
+        "device_ms": ks["device_ms"],
+        "collective_fraction": ks["collective_fraction"],
+        "kernels": [
+            {"name": k["name"], "bucket": k["bucket"], "ms": k["ms"],
+             "fraction": k["fraction"]}
+            for k in ks["kernels"]
+        ],
+        "hbm_peak_bytes": hbm_peak,
+        "live_buffer_bytes": _devprof.summary(reg)["live_buffer_bytes"],
     }
 
 

@@ -41,6 +41,7 @@ from ..resilience import (
     faults,
 )
 from ..telemetry import (
+    devprof,
     fetch_scalars,
     get_registry,
     perf,
@@ -108,6 +109,9 @@ class KalmanFilter:
         if mesh is not None:
             quantum = int(mesh.devices.size) * int(mesh_lane)
             pad_multiple = int(np.lcm(int(pad_multiple), quantum))
+            # /meshz introspection (telemetry.devprof): the mesh axes
+            # this engine partitions over, registered once.
+            devprof.note_mesh(mesh)
         self.gather = make_pixel_gather(state_mask, pad_multiple)
         self._state_propagator = state_propagation
         self.prior = prior
@@ -406,6 +410,11 @@ class KalmanFilter:
                 # same arithmetic as a window with no acquisitions.
                 LOG.info("Skipping degraded date %s (predict-only)", date)
                 continue
+            # The device.oom chaos site: an armed fault here stands in
+            # for XLA's RESOURCE_EXHAUSTED unwinding out of the solve
+            # dispatch below — the flight recorder must attach the
+            # buffer census (telemetry.devprof OOM forensics).
+            faults.fault_point("device.oom", date=str(date))
             t0 = time.time()
             opts = dict(self.solver_options or {})
             if "state_bounds" not in opts and \
@@ -1026,6 +1035,9 @@ class KalmanFilter:
         if self.hessian_correction:
             hess_fwd = getattr(first.operator, "forward_pixel", None)
 
+        # Same device.oom chaos site as the unfused path: the fused
+        # scan dispatch is the block's RESOURCE_EXHAUSTED surface.
+        faults.fault_point("device.oom", date=str(block[0][0]))
         t0 = time.time()
         bands = BandBatch(
             y=jnp.stack([o.bands.y for _, o in block]),

@@ -54,6 +54,12 @@ In-repo sites:
                         quality ledger's drift sentinels must flag
                         (verdict flip + ``quality_drift`` event) while
                         unbiased dates stay bit-identical
+``device.oom``          one window's solve dispatch in
+                        ``engine.filter`` (unfused per-date AND fused
+                        block paths) — stands in for XLA's
+                        RESOURCE_EXHAUSTED; the flight recorder must
+                        attach the devprof buffer census + kernel
+                        table to the crash dump (``device_forensics``)
 ================== ====================================================
 
 Scripting from tests::

@@ -41,6 +41,14 @@ scheduler, the output writers, the CLI drivers and ``bench.py``:
   ``tools/roofline.py``), and on-demand ``jax.profiler`` capture
   (``/profilez``, ``--profile-windows``; BASELINE.md "Performance
   observability");
+- :mod:`devprof` — device-plane observability: XLA kernel-time
+  attribution parsed from ``jax.profiler`` captures (ranked kernel
+  table, fusion/collective/transfer buckets, device lanes folded into
+  the stitched fleet trace), the HBM memory ledger (live-buffer census
+  + headroom gauges + OOM flight-recorder forensics), and
+  mesh/sharding introspection (``/kernelz``, ``/meshz``,
+  ``tools/device_report.py``; BASELINE.md "Device-plane
+  observability");
 - :mod:`slo` — the SLO engine: declarative objectives over the metric
   vocabulary above, multi-window burn-rate alerting (fast window
   pages, slow window warns), a pending/firing/resolved alert state
@@ -53,7 +61,7 @@ event schema, and "Tracing & crash forensics" for the trace/crash
 artifacts.
 """
 
-from . import flight_recorder, live, perf, quality, slo, tracing
+from . import devprof, flight_recorder, live, perf, quality, slo, tracing
 from .compilemon import install_compile_listeners
 from .device import fetch_scalars, record_memory_watermark
 from .registry import (
@@ -68,6 +76,7 @@ from .spans import span, stopwatch
 __all__ = [
     "MetricsRegistry",
     "configure",
+    "devprof",
     "fetch_scalars",
     "flight_recorder",
     "get_registry",

@@ -20,7 +20,10 @@ daemon, rendered by ``tools/fleet_status.py``:
   own pid track (named after its telemetry subdirectory), timestamps
   are aligned on the shared wall-clock epoch every ``TraceBuffer``
   exports (``otherData.epoch_unix_s``), so the scheduler's reclaim and
-  the victim's last span line up in one Perfetto window;
+  the victim's last span line up in one Perfetto window; profiler
+  capture dirs under the same root contribute DEVICE lanes
+  (``telemetry.devprof`` — XLA kernel spans aligned on the
+  ``capture_meta.json`` epoch sidecar) beside the host phase spans;
 - :func:`parse_prom_text` — the mini Prometheus text-format parser the
   exposition round-trip test and the loadgen mid-run scraper use.
 """
@@ -235,6 +238,9 @@ def aggregate_fleet(snapshots: List[dict], now: Optional[float] = None,
             # Per-host SLO alert state (telemetry.slo; absent on
             # pre-SLO snapshots).
             "slo": snap.get("slo"),
+            # Per-host device-plane state (telemetry.devprof; absent
+            # on pre-devprof snapshots).
+            "devprof": snap.get("devprof"),
             "crash_dumps": list(snap.get("crash_dumps") or ()),
             "status": snap.get("status") or {},
             "path": snap.get("_rel") or snap.get("_path"),
@@ -470,11 +476,11 @@ def stitch_traces(root: str, run_id: Optional[str] = None,
                 continue
             doc = filtered
         sources.append((path, doc))
-    if sources:
-        epoch0 = min(
-            float((doc.get("otherData") or {}).get("epoch_unix_s") or 0)
-            for _, doc in sources
-        )
+    epoch0 = min(
+        (float((doc.get("otherData") or {}).get("epoch_unix_s") or 0)
+         for _, doc in sources),
+        default=0.0,
+    )
     events: List[dict] = []
     out_sources: List[dict] = []
     run_ids = set()
@@ -511,6 +517,21 @@ def stitch_traces(root: str, run_id: Optional[str] = None,
         })
     if request_id is not None:
         events.extend(request_flow_events(events))
+    else:
+        # Device lanes (telemetry.devprof): every profiler capture
+        # session under the root joins as its own pid track, XLA kernel
+        # spans aligned on the capture_meta.json epoch sidecar — the
+        # host phase spans and the kernels they dispatched share one
+        # Perfetto window.  Request waterfalls skip this: kernels carry
+        # no request_id.  Late import keeps aggregate importable
+        # standalone (it has no other kafka_tpu dependencies).
+        from . import devprof
+
+        dev_events, dev_sources = devprof.device_lane_tracks(
+            root, epoch0, first_pid=len(sources) + 1
+        )
+        events.extend(dev_events)
+        out_sources.extend(dev_sources)
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",

@@ -47,6 +47,14 @@ def record_memory_watermark(
     (CPU returns None).  Each reading also lands as a trace counter
     track, so HBM pressure lines up with the phase spans in
     ``trace.json``.
+
+    The watermark doubles as the per-window MEMORY LEDGER tick: besides
+    the in-use/peak gauges it publishes the per-device headroom
+    (``bytes_limit - bytes_in_use`` — the distance to an OOM) and
+    refreshes the devprof buffer census (``jax.live_arrays()`` grouped
+    by shape/dtype/sharding; host-side array metadata, still zero
+    transfers), so an OOM's flight-recorder forensics can name the
+    buffers that were resident one window earlier.
     """
     import jax
 
@@ -64,6 +72,7 @@ def record_memory_watermark(
             continue
         in_use = stats.get("bytes_in_use")
         peak = stats.get("peak_bytes_in_use")
+        limit = stats.get("bytes_limit")
         if in_use is not None:
             reg.gauge(
                 "kafka_device_memory_bytes_in_use",
@@ -77,3 +86,14 @@ def record_memory_watermark(
                 "per device)",
             ).set(float(peak), device=d.id)
             reg.trace.add_counter(f"device{d.id}_peak_bytes", peak)
+        if limit is not None and in_use is not None:
+            reg.gauge(
+                "kafka_device_memory_headroom_bytes",
+                "device memory still allocatable (bytes_limit - "
+                "bytes_in_use, per device) — the distance to an OOM",
+            ).set(float(limit) - float(in_use), device=d.id)
+    # Memory-ledger tick (late import: devprof builds on this module's
+    # conventions, no cycle at import time).
+    from . import devprof
+
+    devprof.update_ledger(reg)

@@ -103,6 +103,20 @@ class FlightRecorder:
                         type(exc), exc, exc.__traceback__
                     ),
                 }
+                # Device-plane OOM forensics (telemetry.devprof): a
+                # RESOURCE_EXHAUSTED (or injected device.oom) unwind
+                # gets the live-buffer census, the newest kernel table
+                # and the per-device memory stats attached — the dump
+                # names the resident buffers, not just the allocator's
+                # apology.  Best effort: the dump itself must survive
+                # a forensics failure.
+                try:
+                    from .devprof import forensics, is_oom
+
+                    if is_oom(exc):
+                        rec["device_forensics"] = forensics(reg)
+                except Exception:  # noqa: BLE001 — forensics are garnish on the dump
+                    pass
             os.makedirs(directory, exist_ok=True)
             path = os.path.join(
                 directory,

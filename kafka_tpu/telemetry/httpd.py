@@ -44,6 +44,20 @@ loopback by default:
     exhaustion — human text by default, JSON via ``?json=1``.  Present
     on every instrumented process; shows the stable disabled shape
     when no evaluator was started.
+``/kernelz``
+    the device-plane kernel view (``telemetry.devprof``): the ranked
+    XLA kernel table from the newest parsed profiler capture
+    (fusion/collective/transfer buckets, % device time), the
+    collective-time fraction and the measured-vs-analytic roofline
+    cross-check — human text by default, JSON via ``?json=1``, ``?n=K``
+    bounds the table.  Answers 200 with ``captures_parsed: 0`` before
+    any capture exists — a live probe, never a 404.
+``/meshz``
+    mesh/sharding introspection (``telemetry.devprof``): backend,
+    device topology (id/platform/kind/process), registered mesh axes,
+    partition specs of compiled solve programs, per-device
+    utilization split and collective fraction — text by default,
+    ``?json=1`` for machines.
 
 **Port 0 = disabled** at the CLI layer (:func:`maybe_start`): the
 endpoint is opt-in, a batch run should not open sockets.  The class
@@ -65,7 +79,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
-from . import perf, quality, slo, tracing
+from . import devprof, perf, quality, slo, tracing
 from .live import build_snapshot, crash_dump_index
 from .registry import MetricsRegistry, get_registry
 
@@ -157,10 +171,15 @@ class TelemetryHTTPd:
                 self._requestz(req, reg, parse_qs(parsed.query))
             elif path == "/alertz":
                 self._alertz(req, reg, parse_qs(parsed.query))
+            elif path == "/kernelz":
+                self._kernelz(req, reg, parse_qs(parsed.query))
+            elif path == "/meshz":
+                self._meshz(req, reg, parse_qs(parsed.query))
             elif path == "/":
                 self._send_json(req, 200, {
                     "endpoints": ["/metrics", "/healthz", "/statusz",
-                                  "/profilez", "/requestz", "/alertz"],
+                                  "/profilez", "/requestz", "/alertz",
+                                  "/kernelz", "/meshz"],
                 })
             else:
                 self._send_json(req, 404, {"error": f"no such endpoint "
@@ -327,6 +346,79 @@ class TelemetryHTTPd:
             )
         self._send(req, 200, "\n".join(lines) + "\n")
 
+    def _kernelz(self, req, reg, query: Dict[str, list]) -> None:
+        """Ranked XLA kernel table from the newest parsed capture
+        (``telemetry.devprof``): text by default, ``?json=1`` for the
+        full payload, ``?n=K`` bounds the table.  200 even before any
+        capture was parsed — the empty shape IS the answer."""
+        try:
+            n = int(query.get("n", ["16"])[0])
+        except ValueError:
+            self._send_json(req, 400, {"error": "n must be an integer"})
+            return
+        payload = devprof.kernel_summary(reg, n=n)
+        if query.get("json", ["0"])[0] in ("1", "true"):
+            self._send_json(req, 200, payload)
+            return
+        cf = payload.get("collective_fraction")
+        lines = [
+            f"kernels: {payload['captures_parsed']} capture(s) parsed, "
+            f"device {payload['device_ms']:.3f}ms"
+            + (f", collective {cf:.1%}" if cf is not None else "")
+        ]
+        if not payload["kernels"]:
+            lines.append(
+                "  (no capture parsed yet — trigger one via /profilez "
+                "or --profile-windows)"
+            )
+        for k in payload["kernels"]:
+            lines.append(
+                f"  {k['ms']:10.3f}ms {k['fraction']:6.1%} "
+                f"[{k['bucket']:10s}] x{k['count']} {k['name']}"
+            )
+        rc = payload.get("roofline_crosscheck")
+        if rc:
+            lines.append(
+                f"  roofline: measured {rc['measured_device_ms']:.3f}ms "
+                f"vs analytic floor "
+                f"{rc['analytic_min_ms_per_window']:.4f}ms/window "
+                f"({rc['component']}-bound)"
+            )
+        self._send(req, 200, "\n".join(lines) + "\n")
+
+    def _meshz(self, req, reg, query: Dict[str, list]) -> None:
+        """Mesh/sharding introspection (``telemetry.devprof``): device
+        topology, registered mesh axes, compiled-program partition
+        specs, per-device time split.  Text by default, ``?json=1``."""
+        payload = devprof.mesh_summary(reg)
+        if query.get("json", ["0"])[0] in ("1", "true"):
+            self._send_json(req, 200, payload)
+            return
+        mesh = payload.get("mesh")
+        lines = [
+            f"mesh: backend={payload['backend']} "
+            f"n_devices={payload['n_devices']}"
+            + (f" axes={mesh['axes']}" if mesh else " (no mesh registered)")
+        ]
+        for d in payload["devices"]:
+            lines.append(
+                f"  device {d['id']}: {d['platform']}"
+                + (f" {d['kind']}" if d.get("kind") else "")
+                + f" process={d['process_index']}"
+            )
+        for name, prog in (payload.get("programs") or {}).items():
+            lines.append(
+                f"  program {name}: in={prog.get('in')} "
+                f"out={prog.get('out')}"
+            )
+        split = payload.get("device_time_split") or {}
+        for track, frac in sorted(split.items()):
+            lines.append(f"  time {track}: {frac:.1%}")
+        cf = payload.get("collective_fraction")
+        if cf is not None:
+            lines.append(f"  collective fraction: {cf:.1%}")
+        self._send(req, 200, "\n".join(lines) + "\n")
+
     def _statusz(self, req, reg) -> None:
         ctx = self._run_context()
         solver = {
@@ -358,6 +450,9 @@ class TelemetryHTTPd:
             # payload inline, so one /statusz read answers "is anything
             # firing" too.
             "slo": slo.summary(reg),
+            # Device-plane state (telemetry.devprof): captures parsed,
+            # top kernel, mesh facts, live-buffer bytes.
+            "devprof": devprof.summary(reg),
             "crash_dumps": crash_dump_index(reg.directory),
             "status": status,
         })

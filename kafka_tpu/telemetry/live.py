@@ -39,7 +39,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from . import perf, quality, slo, tracing
+from . import devprof, perf, quality, slo, tracing
 from .registry import MetricsRegistry, _label_text, get_registry
 
 #: snapshot schema version (bumped on breaking changes; consumers skip
@@ -153,6 +153,10 @@ def build_snapshot(registry: Optional[MetricsRegistry] = None,
         # SLO alert state (telemetry.slo): aggregate_fleet folds the
         # firing alerts into the deduped fleet alert view.
         "slo": slo.summary(reg),
+        # Device-plane state (telemetry.devprof): captures parsed, top
+        # kernel, collective fraction, mesh axes, live-buffer bytes —
+        # the fleet view's mesh column.
+        "devprof": devprof.summary(reg),
         "counters": counters,
         "gauges": gauges,
         "histograms": histograms,

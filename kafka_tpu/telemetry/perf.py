@@ -47,7 +47,9 @@ capture recipe.
 from __future__ import annotations
 
 import collections
+import json
 import os
+import shutil
 import threading
 import time
 import weakref
@@ -190,6 +192,10 @@ class _PerfState:
         self.t_origin: Optional[float] = None
         self.px_total = 0.0
         self.device_total = 0.0
+        # (n_pad, n_params, n_bands, component) of the last recorded
+        # window — the problem dims devprof's measured-vs-analytic
+        # roofline cross-check needs.
+        self.last_dims: Optional[Tuple[int, int, int, str]] = None
 
 
 _states: "weakref.WeakKeyDictionary[MetricsRegistry, _PerfState]" = \
@@ -281,6 +287,9 @@ def record_window(rec: dict, *, n_valid: int, n_pad: int, n_params: int,
     phase_gauge.set(device_total / elapsed, phase="solve")
 
     component = component_for(solver_options)
+    with st.lock:
+        st.last_dims = (int(n_pad), int(n_params), int(n_bands),
+                        component)
     util = roofline_utilization(
         component, n_pad, n_params, n_bands, device_s
     )
@@ -293,6 +302,17 @@ def record_window(rec: dict, *, n_valid: int, n_pad: int, n_params: int,
         ).set(util, component=component)
 
     _tick_windowed_capture(reg)
+
+
+def last_window_dims(registry: Optional[MetricsRegistry] = None,
+                     ) -> Optional[Tuple[int, int, int, str]]:
+    """``(n_pad, n_params, n_bands, component)`` of the last recorded
+    window, or None before any window landed — the analytic side of
+    ``devprof.roofline_crosscheck``."""
+    reg = registry if registry is not None else get_registry()
+    st = _state_for(reg)
+    with st.lock:
+        return st.last_dims
 
 
 def summary(registry: Optional[MetricsRegistry] = None) -> dict:
@@ -335,6 +355,12 @@ class CaptureBusy(RuntimeError):
 #: duration, so the knob is bounded.
 MAX_CAPTURE_S = 60.0
 
+#: capture dirs kept under the retention root (<telemetry>/profile) —
+#: the keep-N bound on /profilez / --profile-windows accumulation, same
+#: policy family as the flight recorder's 16-dump cap.  Evictions are
+#: counted and evented, never silent.
+CAPTURE_KEEP = 8
+
 _capture_lock = threading.Lock()
 _windowed = {"remaining": 0, "directory": None}
 _windowed_lock = threading.Lock()
@@ -350,6 +376,17 @@ def _start_trace(directory: str) -> None:
         jax.profiler.start_trace(directory)
     except Exception as exc:  # noqa: BLE001 — backend-specific refusals all mean "cannot capture here"
         raise CaptureUnavailable(f"profiler refused to start: {exc!r}")
+    # Epoch sidecar: the profiler's own timestamps are monotonic ticks
+    # with no wall-clock anchor, so record NOW — devprof pins the
+    # capture's earliest device event to this epoch when folding device
+    # lanes into the stitched fleet trace (aggregate.stitch_traces).
+    try:
+        with open(os.path.join(directory, "capture_meta.json"),
+                  "w") as f:
+            json.dump({"epoch_unix_s": time.time(),
+                       "pid": os.getpid()}, f)
+    except OSError:
+        pass  # alignment degrades; the capture itself is the artifact
 
 
 def _stop_trace() -> None:
@@ -387,6 +424,7 @@ def capture(seconds: float, directory: str,
         "profile_capture", directory=directory, seconds=seconds,
         files=files, wall_s=round(time.perf_counter() - t0, 3),
     )
+    _finish_capture(directory, reg)
     return {"directory": directory, "seconds": seconds, "files": files}
 
 
@@ -450,4 +488,91 @@ def stop_windowed_capture(registry: Optional[MetricsRegistry] = None,
     _captures_total(reg).inc()
     reg.emit("profile_capture", directory=directory, files=files,
              windowed=True)
+    _finish_capture(directory, reg)
     return {"directory": directory, "files": files}
+
+
+def _finish_capture(directory: str, reg: MetricsRegistry) -> None:
+    """Post-capture hooks, both capture paths: parse the fresh capture
+    into devprof's kernel table (so /kernelz is live immediately) and
+    enforce keep-N retention.  Best-effort — the windowed stop runs in
+    the engine's ``finally``, where a telemetry bug must never mask the
+    run's own outcome."""
+    try:
+        from . import devprof
+
+        devprof.ingest_capture(directory, registry=reg)
+        prune_captures(_retention_root(directory), registry=reg)
+    except Exception as exc:  # noqa: BLE001 — post-capture hygiene, never fatal
+        reg.emit("devprof_ingest_failed", directory=directory,
+                 error=repr(exc)[:200])
+
+
+def _retention_root(directory: str) -> str:
+    """The keep-N scope for a capture dir.  ``/profilez`` captures land
+    in ``<telemetry>/profile/<ts>`` (prune across the sibling
+    timestamps); ``--profile-windows`` captures go straight into
+    ``<telemetry>/profile`` (prune inside it)."""
+    directory = directory.rstrip(os.sep)
+    if os.path.basename(directory) == "profile":
+        return directory
+    return os.path.dirname(directory) or directory
+
+
+def prune_captures(root: str, keep: Optional[int] = None,
+                   registry: Optional[MetricsRegistry] = None) -> int:
+    """Keep only the newest ``keep`` profiler capture sessions under
+    ``root``, deleting the oldest beyond the cap (plus their emptied
+    ancestor dirs and epoch sidecars) — a long-lived daemon answering
+    ``/profilez`` must not grow captures without bound.  Every eviction
+    increments ``kafka_perf_capture_evictions_total`` and emits a
+    ``profile_capture_evicted`` event.  Returns the eviction count."""
+    from . import devprof
+
+    reg = registry if registry is not None else get_registry()
+    if keep is None:
+        keep = CAPTURE_KEEP
+    sessions = devprof.find_capture_sessions(root)
+    if keep < 0 or len(sessions) <= keep:
+        return 0
+
+    def mtime(d: str) -> float:
+        try:
+            return os.path.getmtime(d)
+        except OSError:
+            return 0.0
+
+    sessions.sort(key=lambda d: (mtime(d), d))
+    evicted = 0
+    root_abs = os.path.abspath(root)
+    for session in sessions[:len(sessions) - keep]:
+        try:
+            shutil.rmtree(session)
+        except OSError:
+            continue
+        evicted += 1
+        reg.emit("profile_capture_evicted", directory=session,
+                 keep=keep)
+        # Collapse emptied ancestors (the plugins/profile scaffolding
+        # and per-capture roots), stopping at the retention root; an
+        # orphaned epoch sidecar goes with its capture.
+        parent = os.path.dirname(os.path.abspath(session))
+        while parent != root_abs and parent.startswith(root_abs):
+            try:
+                left = os.listdir(parent)
+                if left == ["capture_meta.json"]:
+                    os.unlink(os.path.join(parent, "capture_meta.json"))
+                    left = []
+                if left:
+                    break
+                os.rmdir(parent)
+            except OSError:
+                break
+            parent = os.path.dirname(parent)
+    if evicted:
+        reg.counter(
+            "kafka_perf_capture_evictions_total",
+            "profiler capture sessions evicted by keep-N retention "
+            "(oldest first; default keep=8)",
+        ).inc(evicted)
+    return evicted

@@ -1,0 +1,31 @@
+"""Seeded violations: raw device introspection outside the telemetry
+funnel (rule 20, ``raw-device-introspection``).  ``memory_stats()``,
+``jax.live_arrays()`` and ``jax.profiler.*`` belong in
+``kafka_tpu/telemetry/{device,devprof,perf}.py`` — scattered call
+sites duplicate the watermark gauges, race the buffer census, and
+collide with the one-capture-per-process profiler contract."""
+
+import jax
+from jax import live_arrays, profiler
+
+
+def adhoc_watermark(device):
+    return device.memory_stats()  # expect: raw-device-introspection
+
+
+def adhoc_census():
+    return jax.live_arrays()  # expect: raw-device-introspection
+
+
+def adhoc_census_bare():
+    return live_arrays()  # expect: raw-device-introspection
+
+
+def adhoc_capture(logdir):
+    jax.profiler.start_trace(logdir)  # expect: raw-device-introspection
+    profiler.stop_trace()  # expect: raw-device-introspection
+
+
+def reading_the_gauges_is_fine(reg):
+    # The sanctioned path: consume what the funnel published.
+    return reg.value("kafka_device_memory_headroom_bytes")

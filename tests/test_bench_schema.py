@@ -39,6 +39,7 @@ EXPECTED_KEYS = [
     "probe_device_ms", "probe_host_ms", "probe_retried",
     "unhealthy_reasons", "probe_host_after_ms", "unhealthy",
     "telemetry", "solver_health", "quality", "perf", "slo",
+    "device_profile",
 ]
 
 HEALTH_KEYS = {
@@ -232,6 +233,22 @@ class TestBenchArtifactSchema:
         }
         for o in snap["objectives"].values():
             assert o["budget_remaining"] == 1.0
+
+    def test_device_profile_snapshot_always_present(self):
+        """The device-plane snapshot rides every artifact (ISSUE 18):
+        zeros/None before any capture was parsed, the ranked kernel
+        table and collective fraction after one — so bench_compare can
+        diff where device time went without special-casing keys."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            _, clean = _assemble(reg)
+        snap = clean["device_profile"]
+        assert set(snap) == {
+            "captures_parsed", "device_ms", "collective_fraction",
+            "kernels", "hbm_peak_bytes", "live_buffer_bytes",
+        }
+        assert snap["captures_parsed"] == 0
+        assert snap["kernels"] == []
+        assert snap["collective_fraction"] is None
 
     def test_json_serialisable_one_line(self):
         with telemetry.use(MetricsRegistry()) as reg:

@@ -38,7 +38,7 @@ ALL_RULES = {
     "blocking-call-in-publisher", "magic-quality-threshold",
     "ad-hoc-timing", "nondeterministic-placement",
     "request-id-origin", "magic-slo-threshold",
-    "forward-state-mutation-in-smoother",
+    "forward-state-mutation-in-smoother", "raw-device-introspection",
 }
 
 
@@ -227,7 +227,7 @@ def test_json_output_schema(capsys):
     payload = json.loads(capsys.readouterr().out)
     assert payload["version"] == 1
     assert payload["root"] == os.path.abspath(FIXTURES)
-    assert payload["files_scanned"] == 18
+    assert payload["files_scanned"] == 19
     assert set(payload["rules"]) >= ALL_RULES
     assert isinstance(payload["findings"], list) and payload["findings"]
     for f in payload["findings"]:

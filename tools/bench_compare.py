@@ -29,7 +29,12 @@ write-only.  This tool makes it actionable:
   informationally, with the same class of LOUD warning when a
   previously-clean artifact (zero SLO alerts) shows fired burn-rate
   alerts — a bench that got faster by burning its error budget must
-  not read as a clean win.
+  not read as a clean win;
+- diffs the embedded ``"device_profile"`` snapshots (ISSUE 18: top
+  kernels, collective fraction, HBM peak) informationally, with a
+  LOUD warning when the collective-time fraction grows by more than
+  10 points absolute — a mesh-balance shift masquerading as a kernel
+  result.
 
 Usage:
     python tools/bench_compare.py OLD.json NEW.json [--threshold 0.10]
@@ -264,6 +269,65 @@ def slo_deltas(old: dict, new: dict) -> Tuple[List[str], List[str]]:
     return warnings, lines
 
 
+#: absolute growth of the collective-time fraction beyond this is the
+#: mesh-balance red flag device_profile_deltas warns LOUDLY about.
+COLLECTIVE_FRACTION_WARN = 0.10
+
+
+def device_profile_deltas(old: dict, new: dict,
+                          ) -> Tuple[List[str], List[str]]:
+    """(warnings, report_lines) over the embedded ``device_profile``
+    snapshots (bench.py's compact kernel/HBM view, ISSUE 18).
+
+    Diffed INFORMATIONALLY like the other observability snapshots —
+    where device time went is attribution, not a timing gate — with
+    one loud exception: the collective-time fraction growing by more
+    than :data:`COLLECTIVE_FRACTION_WARN` absolute means the new
+    artifact spends materially more of its device time waiting on the
+    mesh (a sharding/topology change, not a kernel win), so it
+    surfaces as an explicit warning.  Still exit 0.
+    """
+    d_old = old.get("device_profile") or {}
+    d_new = new.get("device_profile") or {}
+    warnings: List[str] = []
+    lines: List[str] = []
+    if not d_old and not d_new:
+        return warnings, lines
+    a, b = d_old.get("captures_parsed", 0), d_new.get(
+        "captures_parsed", 0)
+    if a or b:
+        lines.append(f"  captures_parsed: {a:g} -> {b:g}")
+        lines.append(
+            f"  device_ms: {d_old.get('device_ms', 0):g} -> "
+            f"{d_new.get('device_ms', 0):g}"
+        )
+    cf_old = d_old.get("collective_fraction")
+    cf_new = d_new.get("collective_fraction")
+    if cf_old is not None or cf_new is not None:
+        fmt = (lambda v: "-" if v is None else f"{v:.1%}")
+        lines.append(
+            f"  collective_fraction: {fmt(cf_old)} -> {fmt(cf_new)}"
+        )
+    top_old = (d_old.get("kernels") or [{}])[0].get("name")
+    top_new = (d_new.get("kernels") or [{}])[0].get("name")
+    if top_old != top_new and (top_old or top_new):
+        lines.append(
+            f"  top kernel: {top_old or '-'} -> {top_new or '-'}"
+        )
+    if cf_new is not None and \
+            (cf_new - (cf_old or 0.0)) > COLLECTIVE_FRACTION_WARN:
+        warnings.append(
+            f"collective-time fraction grew {cf_old or 0.0:.1%} -> "
+            f"{cf_new:.1%} (more than "
+            f"{COLLECTIVE_FRACTION_WARN:.0%} absolute): the new "
+            "artifact spends materially more device time waiting on "
+            "the mesh — inspect the kernel table "
+            "(tools/device_report.py) for the collective that grew "
+            "before reading its timings as a kernel-level result"
+        )
+    return warnings, lines
+
+
 def live_telemetry_deltas(old: dict, new: dict) -> List[str]:
     """Informational diff of the embedded ``live_telemetry`` mid-run
     scrape series (tools/loadgen): per shared series, the peak and the
@@ -436,6 +500,13 @@ def main(argv=None) -> int:
         for line in slo_lines:
             print(line)
     for w in slo_warnings:
+        print(f"bench_compare: WARNING {w}", file=sys.stderr)
+    devprof_warnings, devprof_lines = device_profile_deltas(old, new)
+    if devprof_lines:
+        print("device-profile deltas (kernel attribution, not gated):")
+        for line in devprof_lines:
+            print(line)
+    for w in devprof_warnings:
         print(f"bench_compare: WARNING {w}", file=sys.stderr)
     unhealthy = [
         name for name, art in (("old", old), ("new", new))

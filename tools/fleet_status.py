@@ -56,6 +56,19 @@ def render(fleet: dict) -> str:
             extra += f"  perf={p['px_steps_per_s']:.3g}px/s"
             if p.get("device_fraction") is not None:
                 extra += f",df={p['device_fraction']:.2f}"
+        # Per-worker device-plane column (telemetry.devprof): mesh
+        # axes, collective fraction of the newest parsed capture, and
+        # the top kernel — the mesh-balance glance.
+        dp = w.get("devprof") or {}
+        if dp.get("mesh") and (dp["mesh"].get("axes") or {}):
+            axes = ",".join(
+                f"{k}={v}" for k, v in dp["mesh"]["axes"].items()
+            )
+            extra += f"  mesh[{axes}]"
+        if dp.get("collective_fraction") is not None:
+            extra += f"  coll={dp['collective_fraction']:.0%}"
+        elif dp.get("top_kernel"):
+            extra += f"  kern={dp['top_kernel']['name'][:24]}"
         # Per-worker SLO alert state (telemetry.slo): name the firing
         # objectives inline; the deduped fleet line renders below.
         s = w.get("slo") or {}

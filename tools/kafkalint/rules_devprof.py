@@ -1,0 +1,96 @@
+"""Device-plane rule: raw device introspection stays in the telemetry
+funnel.
+
+``raw-device-introspection`` (rule 20, ISSUE 18): the device-plane
+surfaces — ``Device.memory_stats()``, ``jax.live_arrays()`` and the
+``jax.profiler`` capture API — are cheap to call and ruinously easy to
+scatter.  A stray ``memory_stats()`` in engine code duplicates the
+watermark gauges under ad-hoc names, a ``live_arrays()`` census outside
+the ledger races the real one, and a second ``jax.profiler.start_trace``
+collides with the ``/profilez`` single-capture contract (one profiler
+session per process — a second start raises).  Every consumer reads
+these through ``kafka_tpu/telemetry/{device,devprof,perf}.py``, which
+publish the results as metrics, census entries and parsed kernel
+tables everything else (endpoints, fleet view, flight recorder,
+BENCH) consumes.
+
+The rule flags, anywhere OUTSIDE that three-file allowlist:
+
+- any ``.memory_stats()`` attribute call (the per-device PJRT query);
+- ``jax.live_arrays()`` (dotted or imported bare);
+- any dotted ``jax.profiler.*`` call (``trace``, ``TraceAnnotation``,
+  ``start_trace`` ...), including ``profiler.*`` after ``from jax
+  import profiler``.
+
+``utils/profiling.py`` predates the funnel and wraps two profiler
+entry points as degradable context managers; its sites carry inline
+``# kafkalint: disable=raw-device-introspection`` waivers with reasons
+rather than an allowlist hole — new call sites must justify themselves
+the same way.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from . import jitscan
+from .core import FileContext, Finding, Rule, register
+
+#: the telemetry funnel allowed to touch the raw device APIs.
+ALLOWED_FILES = (
+    "kafka_tpu/telemetry/device.py",
+    "kafka_tpu/telemetry/devprof.py",
+    "kafka_tpu/telemetry/perf.py",
+)
+
+
+@register
+class RawDeviceIntrospection(Rule):
+    name = "raw-device-introspection"
+    description = (
+        "raw device introspection (Device.memory_stats(), "
+        "jax.live_arrays(), jax.profiler.*) outside the telemetry "
+        "funnel kafka_tpu/telemetry/{device,devprof,perf}.py — go "
+        "through the watermark gauges, the buffer census and the "
+        "capture plumbing so every consumer reads one accounting"
+    )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None or ctx.rel in ALLOWED_FILES:
+            return ()
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            raw = self._raw_call(node)
+            if raw:
+                findings.append(Finding(
+                    path=ctx.rel, line=node.lineno, rule=self.name,
+                    message=(
+                        f"{raw} outside the telemetry device funnel — "
+                        "read device memory through telemetry.device's "
+                        "watermark/headroom gauges, live buffers "
+                        "through telemetry.devprof's census, and drive "
+                        "profiler captures through telemetry.perf "
+                        "(/profilez, --profile-windows)"
+                    ),
+                ))
+        return findings
+
+    @staticmethod
+    def _raw_call(call: ast.Call) -> str:
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "memory_stats":
+                return ".memory_stats(...)"
+            base = jitscan.dotted(f.value) or ""
+            base_tail = base.rsplit(".", 1)[-1]
+            if f.attr == "live_arrays" and base_tail == "jax":
+                return "jax.live_arrays(...)"
+            if base == "jax.profiler" or base_tail == "profiler":
+                return f"{base}.{f.attr}(...)"
+            return ""
+        if isinstance(f, ast.Name) and f.id == "live_arrays":
+            return "live_arrays(...)"
+        return ""
