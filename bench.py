@@ -549,7 +549,25 @@ def assemble_result(
         # much; tools/bench_compare.py warns LOUDLY when the
         # collective fraction grows.
         "device_profile": devprof_snapshot(reg),
+        # Compact PROGRAM-CONTRACT snapshot (BASELINE.md "Program
+        # contracts"): per-program trace fingerprints plus the contract
+        # finding count from tools/programlint's analyzer — so the
+        # artifact records WHICH device programs it measured;
+        # tools/bench_compare.py warns LOUDLY when a fingerprint drifts
+        # between compared runs (the numbers describe different
+        # programs).
+        "program_contracts": program_contracts_snapshot(),
     }
+
+
+def program_contracts_snapshot() -> dict:
+    """Trace-level contract snapshot (``kafka_tpu.analysis``): cached
+    after the first artifact of the run — the registered programs don't
+    change mid-process — and never raises (analysis failure becomes an
+    ``error`` field, not a dead benchmark)."""
+    from kafka_tpu.analysis import contracts_snapshot
+
+    return contracts_snapshot()
 
 
 def devprof_snapshot(registry=None) -> dict:

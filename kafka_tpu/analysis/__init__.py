@@ -1,0 +1,87 @@
+"""IR-level static analysis of device programs (BASELINE.md "Program
+contracts").
+
+``tools/programlint.py`` drives this package: every registered device
+program is abstractly traced (CPU-only ``jax.make_jaxpr`` over
+``ShapeDtypeStruct`` specs) and verified against machine-checkable
+contracts — dtype hygiene, transfer-freedom, relayout-freedom, and (for
+mesh programs) a collective manifest — with checked-in fingerprint
+manifests under ``contracts/`` guarding against silent drift.
+"""
+
+from .checkers import (
+    AnalysisResult,
+    ContractFinding,
+    analyze,
+    fingerprint,
+    manifest_payload,
+)
+from .registry import (
+    REGISTRY,
+    BuiltProgram,
+    ProgramSpec,
+    get_specs,
+    register_program,
+)
+from .trace import TracedProgram, trace_program
+
+__all__ = [
+    "AnalysisResult",
+    "BuiltProgram",
+    "ContractFinding",
+    "ProgramSpec",
+    "REGISTRY",
+    "TracedProgram",
+    "analyze",
+    "contracts_dir",
+    "contracts_snapshot",
+    "fingerprint",
+    "get_specs",
+    "manifest_payload",
+    "register_program",
+    "trace_program",
+]
+
+
+def contracts_dir() -> str:
+    """The checked-in manifest directory (next to this package)."""
+    import os
+
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "contracts")
+
+
+_SNAPSHOT_CACHE = {}
+
+
+def contracts_snapshot() -> dict:
+    """Compact trace-level snapshot for ``bench.py`` artifacts: per-
+    program fingerprints plus the contract-finding count.  Trace-only
+    (no compile step, so no collective inventory) and cached — the
+    benchmark assembles many artifacts per process and the programs
+    don't change mid-run.  Never raises: an analysis failure becomes an
+    ``error`` field, not a dead benchmark."""
+    if "snap" in _SNAPSHOT_CACHE:
+        return _SNAPSHOT_CACHE["snap"]
+    try:
+        from . import programs  # noqa: F401  (registration side effect)
+
+        result = analyze(
+            get_specs(), contracts_dir=None, compile_collectives=False
+        )
+        snap = {
+            "programs": {
+                name: payload["fingerprint"]
+                for name, payload in sorted(result.reports.items())
+            },
+            "findings": len(result.findings),
+            "clean": result.clean,
+            "error": None,
+        }
+    except Exception as exc:  # pragma: no cover - defensive
+        snap = {
+            "programs": {}, "findings": None, "clean": None,
+            "error": f"{type(exc).__name__}: {exc}",
+        }
+    _SNAPSHOT_CACHE["snap"] = snap
+    return snap

@@ -1,0 +1,466 @@
+"""Contract checkers over traced programs + fingerprint manifests.
+
+Four checkers walk the IR of every registered program
+(:mod:`kafka_tpu.analysis.trace`):
+
+- ``dtype`` — no f64/c128 aval anywhere in device code (catches computed
+  dtypes the AST ``implicit-f64`` lint cannot see), plus the
+  bf16-readiness rule: reduce/dot primitives consuming bf16 must produce
+  f32 accumulators.  The rule is armed now so the planned mixed-precision
+  PR (ROADMAP) inherits its gate instead of shipping one.
+- ``transfer`` — no callback/debug primitives and no host-targeted
+  ``device_put`` inside the traced body: the static twin of the runtime
+  ``kafka_engine_device_reads_total == dispatches`` invariant.
+- ``relayout`` — for programs registered ``relayout_clean``, no
+  transpose/reshape touching a rank-3 (Jacobian-shaped) intermediate —
+  the ``tests/test_solvers.py`` in-kernel jaxpr assertion generalised
+  into a reusable checker.
+- ``collective`` — for mesh programs, every collective op family in the
+  compiled HLO must appear in the program's declared manifest; an
+  unmanifested all-gather is called out as implicit full replication of
+  a sharded operand.
+
+Manifests: one JSON per program under ``contracts/`` records the
+primitive/dtype census and a fingerprint hash.  ``compare_manifest``
+turns any divergence into a ``drift`` finding (kafkalint-style:
+regenerate deliberately with ``--update``, never silently).  Waivers
+live inside each manifest as ``{"checker", "contains", "reason"}``
+entries with stale-waiver semantics — a waiver matching nothing is
+itself a finding, so the waiver set only shrinks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .registry import ProgramSpec
+from .trace import TracedProgram, iter_eqns
+
+#: dtypes forbidden anywhere in a device program.
+FORBIDDEN_DTYPES = ("float64", "complex128")
+
+#: primitives whose presence in a jitted body is a host round-trip.
+TRANSFER_PRIMITIVES = (
+    "pure_callback", "io_callback", "debug_callback", "debug_print",
+)
+
+#: reduce/dot primitives the bf16 accumulate rule applies to.
+REDUCE_DOT_PRIMITIVES = (
+    "dot_general", "reduce_sum", "reduce_prod", "reduce_window_sum",
+    "cumsum",
+)
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class ContractFinding:
+    """One violated contract on one program."""
+
+    program: str
+    checker: str    # dtype | transfer | relayout | collective | drift |
+    #                 manifest | stale-waiver | trace
+    message: str
+
+    def format(self) -> str:
+        return f"{self.program}: [{self.checker}] {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# The four IR checkers.
+# ---------------------------------------------------------------------------
+
+def check_dtype(tp: TracedProgram) -> List[ContractFinding]:
+    out: List[ContractFinding] = []
+    for bad in FORBIDDEN_DTYPES:
+        n = tp.dtypes.get(bad, 0)
+        if n:
+            culprit = _first_eqn_with_dtype(tp, bad)
+            out.append(ContractFinding(
+                program=tp.spec.name, checker="dtype",
+                message=(
+                    f"{bad} appears on {n} value(s) in the traced program"
+                    f"{culprit} — device code is float32-only (the AST "
+                    "implicit-f64 lint cannot see computed dtypes; this "
+                    "checker can)"
+                ),
+            ))
+    for eqn in iter_eqns(tp.closed.jaxpr):
+        if eqn.primitive.name not in REDUCE_DOT_PRIMITIVES:
+            continue
+        in_bf16 = any(
+            str(getattr(v.aval, "dtype", "")) == "bfloat16"
+            for v in eqn.invars if hasattr(v, "aval")
+        )
+        out_bf16 = any(
+            str(getattr(v.aval, "dtype", "")) == "bfloat16"
+            for v in eqn.outvars
+        )
+        if in_bf16 and out_bf16:
+            out.append(ContractFinding(
+                program=tp.spec.name, checker="dtype",
+                message=(
+                    f"'{eqn.primitive.name}' consumes bfloat16 and "
+                    "accumulates in bfloat16 — reduce/dot primitives on "
+                    "bf16 storage must produce f32 accumulators "
+                    "(preferred_element_type=float32); the bf16-readiness "
+                    "gate for the mixed-precision arc"
+                ),
+            ))
+    return out
+
+
+def _first_eqn_with_dtype(tp: TracedProgram, dtype: str) -> str:
+    for eqn in iter_eqns(tp.closed.jaxpr):
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            if aval is not None and str(getattr(aval, "dtype", "")) == dtype:
+                shape = tuple(getattr(aval, "shape", ()))
+                return (f" (first producer: '{eqn.primitive.name}' "
+                        f"-> {dtype}{list(shape)})")
+    return ""
+
+
+def check_transfer(tp: TracedProgram) -> List[ContractFinding]:
+    out: List[ContractFinding] = []
+    counts: Dict[str, int] = {}
+    host_puts = 0
+    for eqn in iter_eqns(tp.closed.jaxpr):
+        name = eqn.primitive.name
+        if name in TRANSFER_PRIMITIVES:
+            counts[name] = counts.get(name, 0) + 1
+        elif name == "device_put" and _is_host_device_put(eqn):
+            host_puts += 1
+    for name in sorted(counts):
+        out.append(ContractFinding(
+            program=tp.spec.name, checker="transfer",
+            message=(
+                f"'{name}' primitive appears {counts[name]}x inside the "
+                "traced body — a host round-trip per execution; the "
+                "device program must stay transfer-free (one packed "
+                "read per window, outside the jitted body)"
+            ),
+        ))
+    if host_puts:
+        out.append(ContractFinding(
+            program=tp.spec.name, checker="transfer",
+            message=(
+                f"device_put with an explicit device/memory target "
+                f"appears {host_puts}x inside the traced body — a "
+                "forced placement (host staging) in device code; "
+                "sharding constraints are fine, concrete-device puts "
+                "are not"
+            ),
+        ))
+    return out
+
+
+def _is_host_device_put(eqn) -> bool:
+    """Only flag device_put with a concrete placement target.  The
+    benign trace-time form (constant promotion) carries
+    ``devices=[None]``; in-program sharding constraints carry Sharding
+    objects, which are layout hints, not transfers."""
+    try:
+        from jax.sharding import Sharding
+    except Exception:                                # pragma: no cover
+        Sharding = ()
+    for dev in (eqn.params.get("devices") or ()):
+        if dev is None or isinstance(dev, Sharding):
+            continue
+        return True
+    return False
+
+
+def check_relayout(tp: TracedProgram) -> List[ContractFinding]:
+    if not tp.spec.relayout_clean:
+        return []
+    out: List[ContractFinding] = []
+    n_transpose = n_reshape = 0
+    example = ""
+    for eqn in iter_eqns(tp.closed.jaxpr):
+        name = eqn.primitive.name
+        if name not in ("transpose", "reshape"):
+            continue
+        aval = getattr(eqn.invars[0], "aval", None)
+        if aval is None or getattr(aval, "ndim", 0) < 3:
+            continue
+        if name == "transpose":
+            n_transpose += 1
+        else:
+            n_reshape += 1
+        if not example:
+            shape = list(getattr(aval, "shape", ()))
+            example = f" (e.g. '{name}' on {aval.dtype}{shape})"
+    if n_transpose or n_reshape:
+        out.append(ContractFinding(
+            program=tp.spec.name, checker="relayout",
+            message=(
+                f"{n_transpose} transpose / {n_reshape} reshape on rank-3 "
+                f"intermediates{example} in a program registered "
+                "relayout_clean — a (B, n, p) Jacobian relayout is an "
+                "extra HBM pass the in-kernel path exists to delete "
+                "(jac_to_rows is the only sanctioned shim, and it lives "
+                "outside relayout-clean programs)"
+            ),
+        ))
+    return out
+
+
+def check_collectives(tp: TracedProgram) -> List[ContractFinding]:
+    if tp.collectives is None:
+        return []
+    out: List[ContractFinding] = []
+    allowed = set(tp.spec.collectives)
+    for op in sorted(tp.collectives):
+        if op in allowed:
+            continue
+        hint = (
+            " — an implicit FULL REPLICATION of a pixel-sharded operand "
+            "(GSPMD gathered a shard because some op's sharding rule "
+            "could not keep it partitioned)"
+            if op == "all-gather" else
+            " — a cross-device dependency the program's manifest does "
+            "not declare"
+        )
+        out.append(ContractFinding(
+            program=tp.spec.name, checker="collective",
+            message=(
+                f"compiled program contains {tp.collectives[op]}x "
+                f"'{op}' not in its collectives manifest "
+                f"{sorted(allowed) or '[]'}{hint}; either the sharding "
+                "regressed or the manifest must be extended deliberately"
+            ),
+        ))
+    return out
+
+
+CHECKERS = (check_dtype, check_transfer, check_relayout, check_collectives)
+
+
+def run_checkers(tp: TracedProgram) -> List[ContractFinding]:
+    findings: List[ContractFinding] = []
+    for checker in CHECKERS:
+        findings.extend(checker(tp))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Fingerprints + manifests.
+# ---------------------------------------------------------------------------
+
+def fingerprint(tp: TracedProgram) -> str:
+    """Deterministic 16-hex-digit digest of the trace-level shape of the
+    program: primitive inventory + dtype census + transfer count.  Trace
+    level on purpose — it is device-count independent and reproducible on
+    any host, unlike compiled-HLO hashes."""
+    transfer_count = sum(
+        tp.primitives.get(p, 0) for p in TRANSFER_PRIMITIVES
+    )
+    payload = json.dumps(
+        {
+            "primitives": dict(sorted(tp.primitives.items())),
+            "dtypes": dict(sorted(tp.dtypes.items())),
+            "transfer_count": transfer_count,
+        },
+        sort_keys=True,
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def manifest_payload(tp: TracedProgram,
+                     waivers: Optional[List[dict]] = None) -> dict:
+    transfer_count = sum(
+        tp.primitives.get(p, 0) for p in TRANSFER_PRIMITIVES
+    )
+    return {
+        "program": tp.spec.name,
+        "description": tp.spec.description,
+        "fingerprint": fingerprint(tp),
+        "eqns": tp.n_eqns,
+        "primitives": dict(sorted(tp.primitives.items())),
+        "dtypes": dict(sorted(tp.dtypes.items())),
+        "transfer_count": transfer_count,
+        "relayout_clean": tp.spec.relayout_clean,
+        "mesh_devices": tp.mesh_devices,
+        "collectives": (
+            None if tp.collectives is None
+            else dict(sorted(tp.collectives.items()))
+        ),
+        "collectives_manifest": sorted(tp.spec.collectives),
+        "waivers": list(waivers or ()),
+    }
+
+
+def manifest_path(contracts_dir: str, name: str) -> str:
+    return os.path.join(contracts_dir, f"{name}.json")
+
+
+def load_manifest(contracts_dir: str, name: str) -> Optional[dict]:
+    path = manifest_path(contracts_dir, name)
+    if not os.path.isfile(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def write_manifest(contracts_dir: str, payload: dict) -> str:
+    os.makedirs(contracts_dir, exist_ok=True)
+    path = manifest_path(contracts_dir, payload["program"])
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def compare_manifest(tp: TracedProgram,
+                     stored: Optional[dict]) -> List[ContractFinding]:
+    """Drift findings between the fresh trace and the checked-in manifest
+    (kafkalint-style: accept drift deliberately with ``--update``)."""
+    name = tp.spec.name
+    if stored is None:
+        return [ContractFinding(
+            program=name, checker="manifest",
+            message=(
+                "no checked-in contract manifest "
+                f"(kafka_tpu/analysis/contracts/{name}.json) — run "
+                "python -m tools.programlint --update to record the "
+                "current fingerprint"
+            ),
+        )]
+    out: List[ContractFinding] = []
+    fp_new = fingerprint(tp)
+    fp_old = stored.get("fingerprint")
+    if fp_old != fp_new:
+        out.append(ContractFinding(
+            program=name, checker="drift",
+            message=(
+                f"trace fingerprint drifted {fp_old} -> {fp_new}"
+                f"{_census_diff(stored.get('primitives') or {}, tp.primitives)} "
+                "— the device program changed shape; review the diff and "
+                "accept deliberately with python -m tools.programlint "
+                "--update"
+            ),
+        ))
+    old_coll = stored.get("collectives")
+    if (old_coll is not None and tp.collectives is not None
+            and dict(old_coll) != dict(tp.collectives)):
+        out.append(ContractFinding(
+            program=name, checker="drift",
+            message=(
+                f"collective inventory drifted {dict(old_coll)} -> "
+                f"{dict(tp.collectives)} — the compiled partitioning "
+                "changed; review and accept with --update"
+            ),
+        ))
+    return out
+
+
+def _census_diff(old: Dict[str, int], new: Dict[str, int],
+                 limit: int = 6) -> str:
+    changed = []
+    for key in sorted(set(old) | set(new)):
+        a, b = old.get(key, 0), new.get(key, 0)
+        if a != b:
+            changed.append(f"{key} {a}->{b}")
+    if not changed:
+        return ""
+    shown = ", ".join(changed[:limit])
+    more = f", +{len(changed) - limit} more" if len(changed) > limit else ""
+    return f" (primitive deltas: {shown}{more})"
+
+
+def apply_waivers(findings: List[ContractFinding], waivers: List[dict],
+                  program: str) -> List[ContractFinding]:
+    """Drop waived findings; report waivers that match nothing as
+    ``stale-waiver`` findings (the manifest-embedded twin of kafkalint's
+    stale-baseline semantics)."""
+    hits = [0] * len(waivers)
+
+    def waived(f: ContractFinding) -> bool:
+        ok = False
+        for i, w in enumerate(waivers):
+            if (w.get("checker") == f.checker
+                    and w.get("contains", "") in f.message):
+                hits[i] += 1
+                ok = True
+        return ok
+
+    kept = [f for f in findings if f.checker == "stale-waiver" or
+            not waived(f)]
+    for i, w in enumerate(waivers):
+        if hits[i] == 0:
+            kept.append(ContractFinding(
+                program=program, checker="stale-waiver",
+                message=(
+                    f"waiver for [{w.get('checker')}] containing "
+                    f"{w.get('contains', '')!r} matches no current "
+                    "finding — remove it (reason was: "
+                    f"{w.get('reason', 'none given')!r})"
+                ),
+            ))
+    return kept
+
+
+# ---------------------------------------------------------------------------
+# The full analysis pass.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class AnalysisResult:
+    findings: List[ContractFinding]
+    reports: Dict[str, dict]        # program -> fresh manifest payload
+    updated: List[str]              # manifest paths written (--update)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def analyze(specs, contracts_dir: Optional[str],
+            update: bool = False,
+            compile_collectives: bool = True) -> AnalysisResult:
+    """Trace + check every spec; compare (or regenerate) manifests.
+
+    ``contracts_dir=None`` skips manifest handling entirely (the fixture
+    tests exercise the checkers in isolation that way).
+    """
+    from .trace import trace_program
+
+    findings: List[ContractFinding] = []
+    reports: Dict[str, dict] = {}
+    updated: List[str] = []
+    for spec in specs:
+        try:
+            tp = trace_program(
+                spec, compile_collectives=compile_collectives
+            )
+        except Exception as exc:  # any builder failure becomes a finding
+            findings.append(ContractFinding(
+                program=spec.name, checker="trace",
+                message=(
+                    f"builder/trace failed: {type(exc).__name__}: "
+                    f"{exc}"
+                ),
+            ))
+            continue
+        stored = (
+            load_manifest(contracts_dir, spec.name)
+            if contracts_dir else None
+        )
+        waivers = list((stored or {}).get("waivers") or ())
+        payload = manifest_payload(tp, waivers=waivers)
+        reports[spec.name] = payload
+        prog_findings = run_checkers(tp)
+        if contracts_dir:
+            if update:
+                updated.append(write_manifest(contracts_dir, payload))
+            else:
+                prog_findings.extend(compare_manifest(tp, stored))
+        findings.extend(
+            apply_waivers(prog_findings, waivers, spec.name)
+        )
+    return AnalysisResult(
+        findings=sorted(findings), reports=reports, updated=updated
+    )

@@ -1,0 +1,117 @@
+"""Device-program registry: canonical abstract specs for contract analysis.
+
+Every entry point whose compiled form the repo's perf story depends on —
+the per-date solve, the fused temporal scan, the smoother sweep, each
+operator's linearize, the mesh-sharded step — is registered here with a
+*builder* that reconstructs the callable plus a canonical abstract
+argument tuple (``jax.ShapeDtypeStruct`` leaves, no concrete data, no
+device).  ``tools/programlint.py`` traces each registered program with
+``jax.make_jaxpr`` and verifies machine-checkable contracts over the IR
+(:mod:`kafka_tpu.analysis.checkers`): dtype hygiene, no host transfers,
+no Jacobian relayouts, and — for mesh programs — a manifest of permitted
+collectives.
+
+The registry is intentionally declarative and import-light: builders run
+lazily at trace time, so importing this module (e.g. from kafkalint's
+rule 21, which only reads ``COVERED_ENTRY_POINTS``) costs nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class BuiltProgram:
+    """What a builder returns: the traceable callable and its canonical
+    abstract arguments.  ``mesh_devices`` is the device count the builder's
+    mesh actually spanned (0 = no mesh — the program is single-device and
+    the collective checker does not apply)."""
+
+    fn: Callable
+    args: Tuple[Any, ...]
+    mesh_devices: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramSpec:
+    """One registered device program.
+
+    ``builder`` — zero-arg callable returning a :class:`BuiltProgram` (or
+    a plain ``(fn, args)`` tuple).  Runs lazily at trace time.
+
+    ``relayout_clean`` — this program promises NO transpose/reshape on
+    rank-3 (Jacobian-shaped) intermediates; the relayout checker enforces
+    it (the generalisation of the ``test_solvers.py`` in-kernel jaxpr
+    assertion).
+
+    ``collectives`` — the manifest of collective op families permitted in
+    the compiled (GSPMD-partitioned) program; anything else is a finding.
+    Only meaningful for mesh builders (``mesh_devices >= 2``).
+
+    ``x64`` — trace under ``jax.experimental.enable_x64()``.  Production
+    programs never set this (x64 stays off, f64 silently downcasts); the
+    fixture specs use it so a seeded f64 upcast is *visible* to the dtype
+    checker, and it arms the checker for any future x64-leak scenario.
+    """
+
+    name: str
+    builder: Callable[[], Any]
+    description: str = ""
+    relayout_clean: bool = False
+    collectives: Tuple[str, ...] = ()
+    x64: bool = False
+
+    def build(self) -> BuiltProgram:
+        built = self.builder()
+        if isinstance(built, BuiltProgram):
+            return built
+        fn, args = built
+        return BuiltProgram(fn=fn, args=tuple(args))
+
+
+#: name -> spec, in registration order (dicts preserve it).
+REGISTRY: Dict[str, ProgramSpec] = {}
+
+
+def register_program(name: str, *, description: str = "",
+                     relayout_clean: bool = False,
+                     collectives: Sequence[str] = (),
+                     x64: bool = False,
+                     registry: Optional[Dict[str, ProgramSpec]] = None):
+    """Decorator registering a builder as a named program spec.
+
+    ``registry`` defaults to the production :data:`REGISTRY`; fixture
+    modules pass their own dict so seeded-violation specs never leak into
+    the production analysis set.
+    """
+    target = REGISTRY if registry is None else registry
+
+    def deco(builder: Callable[[], Any]) -> Callable[[], Any]:
+        if name in target:
+            raise ValueError(f"duplicate program name {name!r}")
+        target[name] = ProgramSpec(
+            name=name, builder=builder, description=description,
+            relayout_clean=relayout_clean,
+            collectives=tuple(collectives), x64=x64,
+        )
+        return builder
+
+    return deco
+
+
+def get_specs(names: Optional[Sequence[str]] = None,
+              registry: Optional[Dict[str, ProgramSpec]] = None,
+              ) -> Tuple[ProgramSpec, ...]:
+    """The selected specs (all, in registration order, when ``names`` is
+    None).  Unknown names raise ``KeyError`` with the known set."""
+    reg = REGISTRY if registry is None else registry
+    if names is None:
+        return tuple(reg.values())
+    unknown = [n for n in names if n not in reg]
+    if unknown:
+        raise KeyError(
+            f"unknown program(s) {unknown}; known: {sorted(reg)}"
+        )
+    return tuple(reg[n] for n in names)

@@ -1,0 +1,157 @@
+"""Abstract tracing of registered programs: jaxpr + (optionally) HLO.
+
+Everything here is device-free: ``jax.make_jaxpr`` over
+``ShapeDtypeStruct`` specs performs abstract evaluation only, and the
+collective inventory compiles for the CPU backend (GSPMD partitioning
+happens at compile time regardless of backend, so all-gather/all-reduce
+insertion is visible in the CPU executable's HLO text).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Iterator, Optional
+
+from .registry import BuiltProgram, ProgramSpec
+
+#: HLO op families counted as collectives (the -start forms cover async
+#: lowering).  ``psum``/``ppermute`` lower to all-reduce/collective-permute.
+COLLECTIVE_OPS = (
+    "all-gather", "all-reduce", "all-to-all", "collective-permute",
+    "reduce-scatter",
+)
+
+
+@dataclasses.dataclass
+class TracedProgram:
+    """One program's IR-level view: the ClosedJaxpr, a recursive primitive
+    census, a dtype census over every aval the trace produced, and — for
+    mesh programs — the compiled HLO's collective inventory."""
+
+    spec: ProgramSpec
+    closed: object                      # jax.core.ClosedJaxpr
+    primitives: Dict[str, int]
+    dtypes: Dict[str, int]
+    n_eqns: int
+    mesh_devices: int = 0
+    collectives: Optional[Dict[str, int]] = None  # None = not compiled
+    collectives_skipped_reason: Optional[str] = None
+
+
+def iter_eqns(jaxpr) -> Iterator[object]:
+    """Every equation in ``jaxpr`` and (recursively) in any sub-jaxpr
+    carried by equation params — pjit bodies, scan/while/cond branches,
+    custom_jvp/vjp call jaxprs, pallas kernels."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for val in eqn.params.values():
+            yield from _iter_param(val)
+
+
+def _iter_param(val) -> Iterator[object]:
+    if hasattr(val, "jaxpr"):            # ClosedJaxpr
+        yield from iter_eqns(val.jaxpr)
+    elif hasattr(val, "eqns"):           # raw Jaxpr
+        yield from iter_eqns(val)
+    elif isinstance(val, (list, tuple)):
+        for item in val:
+            yield from _iter_param(item)
+    elif isinstance(val, dict):
+        for item in val.values():
+            yield from _iter_param(item)
+
+
+def _census(closed) -> Dict[str, int]:
+    prims: Dict[str, int] = {}
+    for eqn in iter_eqns(closed.jaxpr):
+        name = eqn.primitive.name
+        prims[name] = prims.get(name, 0) + 1
+    return prims
+
+
+def _dtype_census(closed) -> Dict[str, int]:
+    """Count avals by dtype: the top-level inputs plus every equation
+    output, recursively — so a computed f64 (upcast mid-program) is
+    counted even though no input or AST literal mentions it."""
+    dtypes: Dict[str, int] = {}
+
+    def add(aval) -> None:
+        dt = getattr(aval, "dtype", None)
+        if dt is None:
+            return
+        key = str(dt)
+        dtypes[key] = dtypes.get(key, 0) + 1
+
+    for var in closed.jaxpr.invars:
+        add(var.aval)
+    for eqn in iter_eqns(closed.jaxpr):
+        for var in eqn.outvars:
+            add(getattr(var, "aval", None))
+    return dtypes
+
+
+def trace_program(spec: ProgramSpec,
+                  compile_collectives: bool = True) -> TracedProgram:
+    """Build and abstractly trace one registered program.
+
+    Raises whatever the builder/trace raises — callers wrap this in a
+    per-program try/except and surface failures as findings rather than
+    crashing the whole analysis run.
+    """
+    import jax
+
+    built: BuiltProgram = spec.build()
+    if spec.x64:
+        with jax.experimental.enable_x64():
+            closed = jax.make_jaxpr(built.fn)(*built.args)
+    else:
+        closed = jax.make_jaxpr(built.fn)(*built.args)
+
+    collectives: Optional[Dict[str, int]] = None
+    skipped: Optional[str] = None
+    if built.mesh_devices >= 2:
+        if compile_collectives:
+            collectives = _collective_inventory(built)
+        else:
+            skipped = "collective compile disabled (--no-collectives)"
+    elif built.mesh_devices == 1:
+        skipped = (
+            "single-device mesh: GSPMD inserts no collectives to inventory"
+        )
+
+    return TracedProgram(
+        spec=spec,
+        closed=closed,
+        primitives=_census(closed),
+        dtypes=_dtype_census(closed),
+        n_eqns=sum(1 for _ in iter_eqns(closed.jaxpr)),
+        mesh_devices=built.mesh_devices,
+        collectives=collectives,
+        collectives_skipped_reason=skipped,
+    )
+
+
+def _collective_inventory(built: BuiltProgram) -> Dict[str, int]:
+    """Counts of collective HLO op families in the compiled program.
+
+    Lowers ahead-of-time on the abstract args (a jitted-with-shardings
+    callable has ``.lower``; anything else is wrapped in ``jax.jit``
+    first) and greps the executable's HLO text — the one representation
+    where GSPMD's inserted collectives are visible.
+    """
+    import jax
+
+    fn = built.fn
+    if not hasattr(fn, "lower"):
+        fn = jax.jit(fn)
+    hlo = fn.lower(*built.args).compile().as_text()
+    out: Dict[str, int] = {}
+    for op in COLLECTIVE_OPS:
+        # Instruction applications read "... = <shape> all-reduce(...)"
+        # (or the async "-start" form); the op name directly abuts the
+        # operand parenthesis, which keeps shape strings and metadata out.
+        n = len(re.findall(rf"(?<![\w-]){re.escape(op)}(?:-start)?\(", hlo))
+        if n:
+            out[op] = n
+    return out

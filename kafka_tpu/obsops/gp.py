@@ -90,6 +90,8 @@ def fit_gp(
     if optimize:
         import optax
 
+        # kafkalint: disable=unregistered-device-program — offline GP
+        # hyperparameter fit, not a serving-engine device program
         def nll(p):
             k = gram(p["log_ell"], p["log_amp"])
             k = k + (noise + jnp.exp(p["log_noise"])) * jnp.eye(k.shape[0])

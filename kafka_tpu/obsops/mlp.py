@@ -73,7 +73,7 @@ def fit_mlp(
     state = opt.init(params)
 
     @jax.jit
-    def step(params, state):
+    def step(params, state):  # kafkalint: disable=unregistered-device-program — offline training step
         def loss(p):
             return jnp.mean((mlp_apply(p, xn) - yn) ** 2)
 

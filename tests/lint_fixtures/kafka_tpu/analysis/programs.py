@@ -1,0 +1,15 @@
+"""Fixture registry twin: the AST-readable covered set for rule 21.
+
+Parsed (never imported) by ``rules_programs.covered_entry_points`` when
+kafkalint runs over the fixture tree — the names below are the fixture
+defs that count as registered device programs, so only the deliberately
+unregistered ones get flagged.
+"""
+
+COVERED_ENTRY_POINTS = {
+    "leaky_update",
+    "flagged_solve",
+    "compliant",
+    "sharded_double",
+    "sharded_scale",
+}

@@ -1,0 +1,32 @@
+"""Seeded host-transfer violation inside a shard_map body.
+
+Parsed by tests/test_lint.py, never imported.  Exercises jitscan's
+shard_map recognition: both the call form (``shard_map(f, ...)``) and
+the decorator form (``@partial(shard_map, ...)``) make the wrapped def a
+jit region, so the host transfer seeded in ``sharded_double`` is caught
+exactly like one inside ``@jax.jit``.  Both defs are listed in the
+fixture ``COVERED_ENTRY_POINTS`` so rule 21 stays quiet here.
+"""
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+
+MESH = object()  # stand-in; the file is parsed, never run
+
+
+def sharded_double(block):
+    leaked = np.asarray(block)  # expect: host-transfer-in-jit
+    return jnp.asarray(leaked) * 2.0
+
+
+double = shard_map(sharded_double, mesh=MESH, in_specs=None,
+                   out_specs=None)
+
+
+@functools.partial(shard_map, mesh=MESH, in_specs=None, out_specs=None)
+def sharded_scale(block):
+    # decorator form: a per-shard device program, but a clean one.
+    return block * 0.5

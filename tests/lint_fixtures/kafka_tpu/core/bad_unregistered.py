@@ -1,0 +1,14 @@
+"""Seeded unregistered-device-program violation.
+
+Parsed by tests/test_lint.py, never imported.  ``rogue_solve`` is a
+jitted entry point in a device package whose def name is NOT in the
+fixture ``COVERED_ENTRY_POINTS`` — a device program no contract
+analyzes, which is exactly what rule 21 exists to flag.
+"""
+
+import jax
+
+
+@jax.jit
+def rogue_solve(x, p_inv):  # expect: unregistered-device-program
+    return x * 2.0 + p_inv.sum(-1)

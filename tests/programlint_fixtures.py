@@ -1,0 +1,117 @@
+"""Seeded contract violations for tools/programlint (the IR-level twin
+of tests/lint_fixtures/): four fixture programs, each violating exactly
+one checker's contract, registered in a private REGISTRY the CLI loads
+via ``--spec-module tests.programlint_fixtures``.
+
+``EXPECT`` mirrors the lint fixtures' ``# expect: <rule>`` convention at
+program granularity: fixture name -> the one checker that must (and the
+only checker that may) report it.
+"""
+
+from __future__ import annotations
+
+from kafka_tpu.analysis.registry import BuiltProgram, register_program
+
+#: fixture program -> the intended checker (and no other).
+EXPECT = {
+    "fixture_f64_upcast": "dtype",
+    "fixture_smuggled_callback": "transfer",
+    "fixture_rank3_relayout": "relayout",
+    "fixture_unmanifested_collective": "collective",
+}
+
+REGISTRY = {}
+
+
+def _sds(shape, dtype="float32"):
+    import jax
+    import numpy as np
+
+    return jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype))
+
+
+@register_program(
+    "fixture_f64_upcast",
+    description="seeded violation: a mid-program astype(float64) upcast "
+                "(traced under x64 so the upcast is visible, exactly the "
+                "leak scenario the dtype checker guards)",
+    x64=True,
+    registry=REGISTRY,
+)
+def _build_f64():
+    import jax.numpy as jnp
+
+    def run(x):
+        acc = x.astype(jnp.float64)       # the seeded upcast
+        return (acc * acc).sum(axis=-1).astype(jnp.float32)
+
+    return run, (_sds((64, 7)),)
+
+
+@register_program(
+    "fixture_smuggled_callback",
+    description="seeded violation: a pure_callback smuggled into the "
+                "traced body — a host round-trip per execution",
+    registry=REGISTRY,
+)
+def _build_callback():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def host_mean(x):
+        return np.mean(x, axis=-1, keepdims=True)
+
+    def run(x):
+        m = jax.pure_callback(
+            host_mean, jax.ShapeDtypeStruct((64, 1), np.float32), x
+        )
+        return jnp.asarray(x) - m
+
+    return run, (_sds((64, 7)),)
+
+
+@register_program(
+    "fixture_rank3_relayout",
+    description="seeded violation: a rank-3 Jacobian-shaped transpose in "
+                "a program registered relayout_clean",
+    relayout_clean=True,
+    registry=REGISTRY,
+)
+def _build_relayout():
+    import jax.numpy as jnp
+
+    def run(jac):
+        # the (n_pix, B, p) -> (B, n_pix, p) relayout the in-kernel path
+        # exists to delete.
+        rows = jnp.transpose(jac, (1, 0, 2))
+        return rows.sum(axis=-1)
+
+    return run, (_sds((64, 2, 7)),)
+
+
+@register_program(
+    "fixture_unmanifested_collective",
+    description="seeded violation: a cross-pixel mean under a pixel-"
+                "sharded 1xN CPU mesh with an EMPTY collectives manifest "
+                "— GSPMD must insert an unmanifested all-reduce",
+    collectives=(),
+    registry=REGISTRY,
+)
+def _build_collective():
+    import jax
+
+    from kafka_tpu.shard.mesh import make_pixel_mesh, pixel_sharding
+
+    devices = jax.devices()
+    mesh = make_pixel_mesh(devices)
+    sh = pixel_sharding(mesh, 0, 1)
+
+    def run(x):
+        return x - x.mean()               # cross-shard reduction
+
+    fn = jax.jit(run, in_shardings=(sh,), out_shardings=sh)
+    n = 128 * max(len(devices), 1)
+    return BuiltProgram(
+        fn=fn, args=(_sds((n,)),), mesh_devices=len(devices)
+    )

@@ -428,3 +428,88 @@ class TestSloDeltas:
         out = capsys.readouterr()
         assert "slo deltas" not in out.out
         assert "WARNING" not in out.err
+
+
+def contracts_artifact(programs=None, findings=0, error=None, **kw):
+    art = artifact(**kw)
+    art["program_contracts"] = {
+        "programs": dict(
+            programs if programs is not None
+            else {"date_twostream_inkernel": "a" * 16,
+                  "linearize_twostream": "b" * 16}
+        ),
+        "findings": findings,
+        "clean": findings == 0,
+        "error": error,
+    }
+    return art
+
+
+class TestProgramContractDeltas:
+    """ISSUE 19 satellite: the "program_contracts" snapshot diffs
+    informationally, and a fingerprint drifting on a shared program —
+    the two artifacts measured DIFFERENT device programs under the same
+    name — warns LOUDLY; never gates, never silence."""
+
+    def test_deltas_reported_not_gated(self, tmp_path, capsys):
+        bc = _load()
+        old = write(tmp_path, "old.json", contracts_artifact())
+        new = write(tmp_path, "new.json", contracts_artifact(
+            programs={"date_twostream_inkernel": "a" * 16,
+                      "linearize_twostream": "b" * 16,
+                      "linearize_wcm": "c" * 16}))
+        assert bc.main([old, new]) == 0
+        out = capsys.readouterr().out
+        assert "program-contract deltas" in out
+        assert "programs: 2 -> 3 (0 fingerprint(s) drifted)" in out
+        assert "new program: linearize_wcm" in out
+
+    def test_fingerprint_drift_warns_loudly(self, tmp_path, capsys):
+        bc = _load()
+        old = write(tmp_path, "old.json", contracts_artifact())
+        new = write(tmp_path, "new.json", contracts_artifact(
+            programs={"date_twostream_inkernel": "f" * 16,
+                      "linearize_twostream": "b" * 16}))
+        assert bc.main([old, new]) == 0  # a warning, not a gate
+        captured = capsys.readouterr()
+        assert "date_twostream_inkernel: fingerprint" in captured.out
+        assert "WARNING" in captured.err
+        assert "drifted for date_twostream_inkernel" in captured.err
+        assert "--update" in captured.err
+
+    def test_new_contract_findings_warn(self, tmp_path, capsys):
+        bc = _load()
+        old = write(tmp_path, "old.json", contracts_artifact(findings=0))
+        new = write(tmp_path, "new.json", contracts_artifact(findings=3))
+        assert bc.main([old, new]) == 0
+        captured = capsys.readouterr()
+        assert "contract findings: 0 -> 3" in captured.out
+        assert "WARNING" in captured.err
+        assert "contract findings went 0 -> 3" in captured.err
+
+    def test_stable_fingerprints_do_not_warn(self, tmp_path, capsys):
+        bc = _load()
+        old = write(tmp_path, "old.json", contracts_artifact())
+        new = write(tmp_path, "new.json", contracts_artifact())
+        assert bc.main([old, new]) == 0
+        assert "WARNING" not in capsys.readouterr().err
+
+    def test_artifacts_without_snapshot_unaffected(self, tmp_path,
+                                                   capsys):
+        bc = _load()
+        old = write(tmp_path, "old.json", artifact())
+        new = write(tmp_path, "new.json", artifact())
+        assert bc.main([old, new]) == 0
+        out = capsys.readouterr()
+        assert "program-contract deltas" not in out.out
+        assert "WARNING" not in out.err
+
+    def test_analysis_error_is_reported(self, tmp_path, capsys):
+        bc = _load()
+        old = write(tmp_path, "old.json", contracts_artifact())
+        new = write(tmp_path, "new.json", contracts_artifact(
+            programs={}, findings=None,
+            error="RuntimeError: trace failed"))
+        assert bc.main([old, new]) == 0
+        out = capsys.readouterr().out
+        assert "new: analysis error: RuntimeError: trace failed" in out

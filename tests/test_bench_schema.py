@@ -39,7 +39,7 @@ EXPECTED_KEYS = [
     "probe_device_ms", "probe_host_ms", "probe_retried",
     "unhealthy_reasons", "probe_host_after_ms", "unhealthy",
     "telemetry", "solver_health", "quality", "perf", "slo",
-    "device_profile",
+    "device_profile", "program_contracts",
 ]
 
 HEALTH_KEYS = {
@@ -249,6 +249,28 @@ class TestBenchArtifactSchema:
         assert snap["captures_parsed"] == 0
         assert snap["kernels"] == []
         assert snap["collective_fraction"] is None
+
+    def test_program_contracts_snapshot_always_present(self):
+        """The program-contract snapshot rides every artifact (ISSUE
+        19): per-program trace fingerprints + the contract finding
+        count, so bench_compare can warn when two artifacts measured
+        DIFFERENT device programs under the same names.  Cached after
+        the first assembly — the registry is process-constant."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            _, result = _assemble(reg)
+        snap = result["program_contracts"]
+        assert set(snap) == {"programs", "findings", "clean", "error"}
+        assert snap["error"] is None
+        assert snap["clean"] is True and snap["findings"] == 0
+        assert len(snap["programs"]) >= 14
+        assert all(
+            isinstance(fp, str) and len(fp) == 16
+            for fp in snap["programs"].values()
+        )
+        # cached: the second artifact reuses the same snapshot object.
+        with telemetry.use(MetricsRegistry()) as reg:
+            _, again = _assemble(reg)
+        assert again["program_contracts"] is snap
 
     def test_json_serialisable_one_line(self):
         with telemetry.use(MetricsRegistry()) as reg:

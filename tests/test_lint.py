@@ -39,6 +39,7 @@ ALL_RULES = {
     "ad-hoc-timing", "nondeterministic-placement",
     "request-id-origin", "magic-slo-threshold",
     "forward-state-mutation-in-smoother", "raw-device-introspection",
+    "unregistered-device-program",
 }
 
 
@@ -207,6 +208,36 @@ def test_stale_baseline_entry_is_a_finding(tmp_path):
     assert "tools/gone.py" in result.findings[0].message
 
 
+def test_baseline_update_regenerates_and_grandfathers(tmp_path, capsys):
+    _write_tree(tmp_path, "legacy.py", _VIOLATION)
+    assert cli.main([str(tmp_path)]) == 1  # dirty before
+    capsys.readouterr()
+    assert cli.main([str(tmp_path), "--baseline-update"]) == 0
+    out = capsys.readouterr().out
+    assert "wrote 1 baseline entry" in out
+    bl_path = tmp_path / "tools" / "kafkalint" / "baseline.json"
+    entries = json.loads(bl_path.read_text())
+    assert [
+        (e["rule"], e["path"]) for e in entries
+    ] == [("bare-except", "tools/legacy.py")]
+    assert all(e["contains"] and e["reason"] for e in entries)
+    # the regenerated baseline grandfathers the finding...
+    capsys.readouterr()
+    assert cli.main([str(tmp_path)]) == 0
+    # ...and stale semantics are unchanged: fix the code, entry goes
+    # stale and is itself a finding.
+    _write_tree(tmp_path, "legacy.py", "X = 1\n")
+    result = run_lint(str(tmp_path))
+    assert [f.rule for f in result.findings] == ["stale-baseline"]
+
+
+def test_baseline_update_on_clean_tree_writes_empty_list(tmp_path, capsys):
+    _write_tree(tmp_path, "ok.py", "X = 1\n")
+    assert cli.main([str(tmp_path), "--baseline-update"]) == 0
+    bl_path = tmp_path / "tools" / "kafkalint" / "baseline.json"
+    assert json.loads(bl_path.read_text()) == []
+
+
 def test_no_baseline_flag_ignores_baseline(tmp_path):
     _write_tree(tmp_path, "legacy.py", _VIOLATION)
     _write_baseline(tmp_path, [{
@@ -227,7 +258,7 @@ def test_json_output_schema(capsys):
     payload = json.loads(capsys.readouterr().out)
     assert payload["version"] == 1
     assert payload["root"] == os.path.abspath(FIXTURES)
-    assert payload["files_scanned"] == 19
+    assert payload["files_scanned"] == 22
     assert set(payload["rules"]) >= ALL_RULES
     assert isinstance(payload["findings"], list) and payload["findings"]
     for f in payload["findings"]:

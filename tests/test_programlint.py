@@ -1,0 +1,264 @@
+"""Tier-1 wiring of tools/programlint (ISSUE 19): every registered
+device program is abstractly traced on CPU and verified against its
+contracts — dtype hygiene, transfer-freedom, relayout-freedom, the
+collective manifest and the checked-in fingerprint manifests — on every
+test run, so an f64 upcast, a smuggled callback, a Jacobian relayout or
+a surprise all-gather breaks the suite, not a TPU bench run later.
+
+Also pins the analyzer itself: each seeded violation in
+tests/programlint_fixtures.py must be reported by exactly its intended
+checker (the ``EXPECT`` map — the IR-level twin of the lint fixtures'
+``# expect:`` convention), manifests must round-trip with drift/waiver
+semantics, and the CLI exit codes must stay stable.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from kafka_tpu import analysis  # noqa: E402
+from kafka_tpu.analysis import checkers, trace  # noqa: E402
+from kafka_tpu.analysis import programs  # noqa: E402,F401  (registration)
+from tests import programlint_fixtures  # noqa: E402  (fixture registry)
+from tools import programlint  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def production_result():
+    """One full analysis pass over every production program, shared by
+    the tier-1 assertions below (tracing is deterministic)."""
+    return analysis.analyze(
+        analysis.get_specs(), contracts_dir=analysis.contracts_dir()
+    )
+
+
+# ---------------------------------------------------------------------------
+# Tier-1: the production programs must analyze clean.
+# ---------------------------------------------------------------------------
+
+def test_all_production_programs_clean(production_result):
+    assert production_result.findings == [], "\n".join(
+        f.format() for f in production_result.findings
+    )
+
+
+def test_production_manifests_checked_in_and_waiver_free(production_result):
+    names = set(analysis.REGISTRY)
+    on_disk = {
+        fn[:-len(".json")]
+        for fn in os.listdir(analysis.contracts_dir())
+        if fn.endswith(".json")
+    }
+    assert on_disk == names
+    for name in names:
+        stored = checkers.load_manifest(analysis.contracts_dir(), name)
+        assert stored["waivers"] == []  # the goal state, like the baseline
+        assert stored["fingerprint"] == \
+            production_result.reports[name]["fingerprint"]
+
+
+def test_registry_covers_the_flagship_programs():
+    names = set(analysis.REGISTRY)
+    assert {
+        "date_twostream_xla", "date_twostream_inkernel",
+        "date_twostream_jac_to_rows", "windows_scan_twostream",
+        "windows_scan_twostream_inkernel", "smoother_rts_sweep",
+        "sharded_step_tip", "sharded_forward_tip",
+    } <= names
+    assert sum(1 for n in names if n.startswith("linearize_")) >= 6
+
+
+def test_mesh_program_collectives_are_inventoried(production_result):
+    step = production_result.reports["sharded_step_tip"]
+    assert step["mesh_devices"] >= 2
+    assert set(step["collectives"]) <= {"all-reduce"}
+    assert step["collectives"]  # the convergence norm must be there
+    fwd = production_result.reports["sharded_forward_tip"]
+    assert fwd["collectives"] == {}
+
+
+def test_fingerprints_are_deterministic():
+    spec = analysis.REGISTRY["linearize_twostream"]
+    fp = [
+        analysis.fingerprint(
+            trace.trace_program(spec, compile_collectives=False)
+        )
+        for _ in range(2)
+    ]
+    assert fp[0] == fp[1] and len(fp[0]) == 16
+
+
+# ---------------------------------------------------------------------------
+# Seeded fixtures: each violation caught by exactly its intended checker.
+# ---------------------------------------------------------------------------
+
+def _fixture_findings(name):
+    spec = programlint_fixtures.REGISTRY[name]
+    tp = trace.trace_program(spec)
+    return checkers.run_checkers(tp)
+
+
+@pytest.mark.parametrize(
+    "name,expected_checker", sorted(programlint_fixtures.EXPECT.items())
+)
+def test_seeded_fixture_caught_by_exactly_its_checker(name,
+                                                      expected_checker):
+    findings = _fixture_findings(name)
+    assert {f.checker for f in findings} == {expected_checker}, \
+        "\n".join(f.format() for f in findings)
+
+
+def test_fixture_expect_map_spans_all_four_checkers():
+    assert set(programlint_fixtures.EXPECT.values()) == {
+        "dtype", "transfer", "relayout", "collective",
+    }
+    assert set(programlint_fixtures.EXPECT) == \
+        set(programlint_fixtures.REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Manifest mechanics: missing -> update -> clean -> drift; waivers.
+# ---------------------------------------------------------------------------
+
+def _toy_registry():
+    registry = {}
+
+    @analysis.register_program(
+        "toy_scale", description="manifest round-trip probe",
+        registry=registry,
+    )
+    def _build():
+        import jax
+        import numpy as np
+
+        return (
+            lambda x: x * 2.0,
+            (jax.ShapeDtypeStruct((8,), np.float32),),
+        )
+
+    return registry
+
+
+def test_manifest_roundtrip_and_drift(tmp_path):
+    registry = _toy_registry()
+    specs = analysis.get_specs(registry=registry)
+    cdir = str(tmp_path)
+
+    missing = analysis.analyze(specs, contracts_dir=cdir)
+    assert [f.checker for f in missing.findings] == ["manifest"]
+    assert "--update" in missing.findings[0].message
+
+    updated = analysis.analyze(specs, contracts_dir=cdir, update=True)
+    assert updated.findings == []
+    assert [os.path.basename(p) for p in updated.updated] == \
+        ["toy_scale.json"]
+
+    clean = analysis.analyze(specs, contracts_dir=cdir)
+    assert clean.findings == []
+
+    stored = checkers.load_manifest(cdir, "toy_scale")
+    stored["fingerprint"] = "0" * 16
+    checkers.write_manifest(cdir, stored)
+    drifted = analysis.analyze(specs, contracts_dir=cdir)
+    assert [f.checker for f in drifted.findings] == ["drift"]
+    assert "0000000000000000 ->" in drifted.findings[0].message
+
+
+def test_waiver_silences_and_goes_stale(tmp_path):
+    spec = programlint_fixtures.REGISTRY["fixture_smuggled_callback"]
+    cdir = str(tmp_path)
+    analysis.analyze([spec], contracts_dir=cdir, update=True)
+
+    stored = checkers.load_manifest(cdir, spec.name)
+    stored["waivers"] = [{
+        "checker": "transfer", "contains": "pure_callback",
+        "reason": "seeded fixture, waiver mechanics probe",
+    }]
+    checkers.write_manifest(cdir, stored)
+    waived = analysis.analyze([spec], contracts_dir=cdir)
+    assert waived.findings == []
+
+    stored["waivers"] = [{
+        "checker": "dtype", "contains": "no such finding",
+        "reason": "stale on purpose",
+    }]
+    checkers.write_manifest(cdir, stored)
+    stale = analysis.analyze([spec], contracts_dir=cdir)
+    by_checker = {f.checker for f in stale.findings}
+    assert by_checker == {"transfer", "stale-waiver"}
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes, --json schema, --spec-module, --list.
+# ---------------------------------------------------------------------------
+
+def test_cli_clean_subset_exits_zero(capsys):
+    rc = programlint.main(["--programs", "linearize_twostream"])
+    assert rc == 0
+    assert "clean (1 programs" in capsys.readouterr().out
+
+
+def test_cli_fixture_violation_exits_one_naming_checker(capsys):
+    rc = programlint.main([
+        "--spec-module", "tests.programlint_fixtures", "--no-manifest",
+        "--programs", "fixture_f64_upcast",
+    ])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "[dtype]" in err and "fixture_f64_upcast" in err
+
+
+def test_cli_json_schema(capsys):
+    rc = programlint.main([
+        "--spec-module", "tests.programlint_fixtures", "--no-manifest",
+        "--programs", "fixture_rank3_relayout", "--json",
+    ])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["version"] == 1
+    assert set(payload["programs"]) == {"fixture_rank3_relayout"}
+    report = payload["programs"]["fixture_rank3_relayout"]
+    assert {"fingerprint", "eqns", "primitives", "dtypes",
+            "relayout_clean", "collectives_manifest"} <= set(report)
+    assert payload["findings"] and all(
+        set(f) == {"program", "checker", "message"}
+        for f in payload["findings"]
+    )
+    assert payload["findings"][0]["checker"] == "relayout"
+
+
+def test_cli_unknown_program_and_bad_module_exit_two(capsys):
+    assert programlint.main(["--programs", "no_such_program"]) == 2
+    assert "no_such_program" in capsys.readouterr().err
+    assert programlint.main(["--spec-module", "json"]) == 2
+    assert "REGISTRY" in capsys.readouterr().err
+
+
+def test_cli_list_names_every_program(capsys):
+    assert programlint.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for name in analysis.REGISTRY:
+        assert name in out
+    assert "relayout-clean" in out
+
+
+def test_cli_subprocess_entry_point():
+    """`python -m tools.programlint` works cold (fresh interpreter, no
+    conftest): the CLI owns its CPU/device-count environment."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.programlint", "--programs",
+         "linearize_wcm"],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+        env={k: v for k, v in os.environ.items()
+             if k not in ("JAX_PLATFORMS", "XLA_FLAGS")},
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "clean" in proc.stdout

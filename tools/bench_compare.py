@@ -328,6 +328,68 @@ def device_profile_deltas(old: dict, new: dict,
     return warnings, lines
 
 
+def program_contracts_deltas(old: dict, new: dict,
+                             ) -> Tuple[List[str], List[str]]:
+    """(warnings, report_lines) over the embedded ``program_contracts``
+    snapshots (bench.py's per-program trace fingerprints, ISSUE 19).
+
+    Informational lines, but fingerprint drift on a shared program
+    warns LOUDLY: the two artifacts compiled DIFFERENT device programs
+    under the same name, so their timing rows are not the same
+    measurement — accept the drift deliberately (python -m
+    tools.programlint --update) before trusting the comparison.  A
+    finding count going 0 -> N warns too (the new run's programs
+    violate contracts the old run's did not).  Still exit 0.
+    """
+    c_old = old.get("program_contracts") or {}
+    c_new = new.get("program_contracts") or {}
+    warnings: List[str] = []
+    lines: List[str] = []
+    if not c_old and not c_new:
+        return warnings, lines
+    for side, c in (("old", c_old), ("new", c_new)):
+        if c.get("error"):
+            lines.append(f"  {side}: analysis error: {c['error']}")
+    p_old = c_old.get("programs") or {}
+    p_new = c_new.get("programs") or {}
+    drifted = sorted(
+        name for name in set(p_old) & set(p_new)
+        if p_old[name] != p_new[name]
+    )
+    lines.append(
+        f"  programs: {len(p_old)} -> {len(p_new)} "
+        f"({len(drifted)} fingerprint(s) drifted)"
+    )
+    for name in sorted(set(p_new) - set(p_old)):
+        lines.append(f"  new program: {name} ({p_new[name]})")
+    for name in sorted(set(p_old) - set(p_new)):
+        lines.append(f"  removed program: {name}")
+    for name in drifted:
+        lines.append(
+            f"  {name}: fingerprint {p_old[name]} -> {p_new[name]}"
+        )
+    if drifted:
+        warnings.append(
+            f"program fingerprint(s) drifted for {', '.join(drifted)}: "
+            "the compared artifacts traced DIFFERENT device programs "
+            "under the same name, so their timing rows are not the same "
+            "measurement — review the drift (python -m tools.programlint) "
+            "and accept it deliberately with --update before reading "
+            "these rows as a like-for-like comparison"
+        )
+    f_old, f_new = c_old.get("findings"), c_new.get("findings")
+    if f_old is not None or f_new is not None:
+        lines.append(f"  contract findings: {f_old} -> {f_new}")
+    if not f_old and f_new:
+        warnings.append(
+            f"contract findings went {f_old or 0} -> {f_new}: the new "
+            "artifact's device programs violate contracts the old one "
+            "satisfied — run python -m tools.programlint for the "
+            "finding list before trusting the new numbers"
+        )
+    return warnings, lines
+
+
 def live_telemetry_deltas(old: dict, new: dict) -> List[str]:
     """Informational diff of the embedded ``live_telemetry`` mid-run
     scrape series (tools/loadgen): per shared series, the peak and the
@@ -507,6 +569,14 @@ def main(argv=None) -> int:
         for line in devprof_lines:
             print(line)
     for w in devprof_warnings:
+        print(f"bench_compare: WARNING {w}", file=sys.stderr)
+    contract_warnings, contract_lines = program_contracts_deltas(
+        old, new)
+    if contract_lines:
+        print("program-contract deltas (traced programs, not gated):")
+        for line in contract_lines:
+            print(line)
+    for w in contract_warnings:
         print(f"bench_compare: WARNING {w}", file=sys.stderr)
     unhealthy = [
         name for name, art in (("old", old), ("new", new))

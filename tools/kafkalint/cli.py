@@ -8,7 +8,7 @@ import os
 import sys
 from typing import List, Optional
 
-from .core import REGISTRY, make_rules, run_lint
+from .core import BASELINE_RELPATH, REGISTRY, make_rules, run_lint
 
 
 def _default_root() -> str:
@@ -36,9 +36,44 @@ def build_parser() -> argparse.ArgumentParser:
                         "<root>/tools/kafkalint/baseline.json if present)")
     p.add_argument("--no-baseline", action="store_true",
                    help="ignore any baseline file")
+    p.add_argument("--baseline-update", action="store_true",
+                   help="regenerate the baseline from the current "
+                        "findings (grandfather everything; stale "
+                        "semantics unchanged — entries that later match "
+                        "nothing become stale-baseline findings)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the registered rules and exit")
     return p
+
+
+def _baseline_update(root: str, rule_names: Optional[List[str]],
+                     baseline_path: Optional[str]) -> int:
+    """Regenerate the baseline file from the current (un-baselined)
+    findings.  One entry per distinct (rule, path, message), with the
+    full message as ``contains`` so an entry stops matching — and goes
+    stale — the moment the finding changes at all."""
+    result = run_lint(root, rule_names=rule_names, use_baseline=False)
+    path = baseline_path or os.path.join(root, BASELINE_RELPATH)
+    entries = []
+    seen = set()
+    for f in result.findings:
+        key = (f.rule, f.path, f.message)
+        if key in seen:
+            continue
+        seen.add(key)
+        entries.append({
+            "rule": f.rule, "path": f.path, "contains": f.message,
+            "reason": "grandfathered by --baseline-update",
+        })
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(entries, fh, indent=2)
+        fh.write("\n")
+    print(
+        f"kafkalint: wrote {len(entries)} baseline entr"
+        f"{'y' if len(entries) == 1 else 'ies'} to {path}"
+    )
+    return 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -57,6 +92,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.rules else None
     )
     try:
+        if args.baseline_update:
+            return _baseline_update(root, rule_names, args.baseline)
         result = run_lint(
             root, rule_names=rule_names, baseline_path=args.baseline,
             use_baseline=not args.no_baseline,

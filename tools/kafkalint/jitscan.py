@@ -5,6 +5,10 @@ A *jit region* is a function whose body is traced and runs on device:
 - defs decorated with ``jax.jit`` / ``pjit`` / ``pmap`` (directly or via
   ``functools.partial(jax.jit, ...)``);
 - callables handed to ``jax.jit(...)`` / ``pjit(...)`` call forms;
+- ``shard_map`` bodies — call form ``shard_map(f, mesh=..., ...)`` and
+  decorator form ``@partial(shard_map, ...)``: the wrapped function is a
+  per-shard device program exactly like a jit body (its ``static_argnums``
+  stay ``None`` — shard_map has no statics for the static-arg rule);
 - Pallas kernels (first argument of ``pl.pallas_call``);
 - bodies of structured control flow: ``lax.scan`` / ``lax.map`` /
   ``lax.while_loop`` / ``lax.fori_loop`` / ``lax.cond`` / ``lax.switch``;
@@ -26,6 +30,7 @@ from typing import Dict, List, Optional, Tuple, Union
 FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
 
 JIT_TAILS = {"jit", "pjit", "pmap"}
+SHARD_MAP_TAIL = "shard_map"
 TRANSFORM_TAILS = {"value_and_grad", "grad", "vmap", "checkpoint", "remat"}
 
 #: control-flow entry points -> indices of their callable arguments.
@@ -148,6 +153,13 @@ def _decorator_entry(fn: FuncNode) -> Optional[JitEntry]:
                     static_argnums=nums, static_argnames=names,
                     statics_known=known,
                 )
+            if t == SHARD_MAP_TAIL:
+                return JitEntry(fn, via=f"@{dotted(d.func)}(...)")
+            if (t == "partial" and d.args
+                    and tail(d.args[0]) == SHARD_MAP_TAIL):
+                return JitEntry(
+                    fn, via=f"@partial({dotted(d.args[0])}, ...)"
+                )
     return None
 
 
@@ -186,6 +198,11 @@ def jit_entries(tree: ast.AST) -> List[JitEntry]:
                     add(func, via=f"{dotted(node.func)}(...) call",
                         static_argnums=nums, static_argnames=names,
                         statics_known=known)
+            elif t == SHARD_MAP_TAIL and node.args:
+                # per-shard body: a jit region, but with no jit statics —
+                # static_argnums stays None so the static-arg rule skips.
+                for func in resolve_callable(node.args[0], defs):
+                    add(func, via=f"{dotted(node.func)}(...) call")
             elif t in _BODY_ARGS and _is_lax_call(node.func, t):
                 spec = _BODY_ARGS[t]
                 idxs = (

@@ -1,0 +1,123 @@
+"""Program-registry rule: every device entry point is contract-analyzed.
+
+``unregistered-device-program`` (rule 21, ISSUE 19): the programlint
+analyzer (``tools/programlint.py``) verifies dtype/transfer/relayout/
+collective contracts over the *registered* device programs — a jitted
+entry point nobody registered is a device program with no contract, and
+its regressions (an f64 upcast, a smuggled callback, a surprise
+all-gather) ship silently.  This rule closes the loop from the source
+side: any ``jit``/``pjit``/``pmap``/``pallas_call``/``shard_map`` entry
+point defined in the device packages (``kafka_tpu/{core,engine,smoother,
+obsops,shard}/``) must have its def name listed in
+``COVERED_ENTRY_POINTS`` in ``kafka_tpu/analysis/programs.py`` — which in
+practice means a registered program traces through it.
+
+The covered set is read by AST (``ast.literal_eval`` on the
+``COVERED_ENTRY_POINTS`` assignment) from the linted root's own
+``kafka_tpu/analysis/programs.py``, so fixture trees carry their own
+small registry and the rule never imports jax.  Host-side training
+helpers that are jitted but deliberately not device programs of the
+serving engine (e.g. the GP/MLP calibration steps) carry inline
+``# kafkalint: disable=unregistered-device-program`` waivers with
+reasons, exactly like every other grandfathered exception.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import FrozenSet, Iterable, List, Optional
+
+from . import jitscan
+from .core import FileContext, Finding, Rule, register
+
+#: packages whose jit entries must be registry-covered.
+DEVICE_PACKAGES = (
+    "kafka_tpu/core/", "kafka_tpu/engine/", "kafka_tpu/smoother/",
+    "kafka_tpu/obsops/", "kafka_tpu/shard/",
+)
+
+#: the AST-readable registry twin, relative to the linted root.
+REGISTRY_RELPATH = os.path.join("kafka_tpu", "analysis", "programs.py")
+
+#: ``via`` markers that make an entry a compiled device program root
+#: (control-flow bodies like ``body of lax.scan`` are inside one of
+#: these, never independent programs).
+_PROGRAM_MARKERS = ("jit", "pmap", "pallas_call", "shard_map")
+
+
+def covered_entry_points(root: str) -> Optional[FrozenSet[str]]:
+    """``COVERED_ENTRY_POINTS`` parsed from the root's registry module,
+    or None when the module (or the literal) is absent/unreadable."""
+    path = os.path.join(root, REGISTRY_RELPATH)
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        targets = ()
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = (node.target,)
+        for t in targets:
+            if (isinstance(t, ast.Name)
+                    and t.id == "COVERED_ENTRY_POINTS"):
+                try:
+                    val = ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    return None
+                return frozenset(str(v) for v in val)
+    return None
+
+
+@register
+class UnregisteredDeviceProgram(Rule):
+    name = "unregistered-device-program"
+    description = (
+        "jit/pjit/pmap/pallas_call/shard_map entry point in the device "
+        "packages whose def name is not in COVERED_ENTRY_POINTS of "
+        "kafka_tpu/analysis/programs.py — register a program spec so "
+        "tools/programlint.py verifies its dtype/transfer/relayout/"
+        "collective contracts, or waive it inline with a reason"
+    )
+
+    def __init__(self) -> None:
+        self._covered: Optional[FrozenSet[str]] = None
+        self._covered_root: Optional[str] = None
+
+    def _covered_for(self, root: str) -> Optional[FrozenSet[str]]:
+        if self._covered_root != root:
+            self._covered_root = root
+            self._covered = covered_entry_points(root)
+        return self._covered
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return ()
+        if not any(ctx.rel.startswith(p) for p in DEVICE_PACKAGES):
+            return ()
+        covered = self._covered_for(ctx.root)
+        if covered is None:
+            # No registry in this tree: nothing to check against (the
+            # production tree always has one; bare tmp trees don't).
+            return ()
+        findings: List[Finding] = []
+        for entry in jitscan.jit_entries(ctx.tree):
+            if entry.name == "<lambda>":
+                continue
+            if not any(m in entry.via for m in _PROGRAM_MARKERS):
+                continue
+            if entry.name in covered:
+                continue
+            findings.append(Finding(
+                path=ctx.rel, line=entry.func.lineno, rule=self.name,
+                message=(
+                    f"device program '{entry.name}' (via {entry.via}) "
+                    "is not in COVERED_ENTRY_POINTS of "
+                    "kafka_tpu/analysis/programs.py — register an "
+                    "abstract spec so programlint traces its contracts"
+                ),
+            ))
+        return findings

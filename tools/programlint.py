@@ -1,0 +1,176 @@
+"""programlint: IR-level contract analysis of registered device programs.
+
+The static twin of kafkalint one level deeper: where kafkalint pattern-
+matches source text, programlint abstractly traces every program
+registered in ``kafka_tpu.analysis.programs`` (CPU-only
+``jax.make_jaxpr`` / AOT lowering on ``ShapeDtypeStruct`` specs — no
+device, no data) and verifies contracts over the actual IR: no f64, no
+host transfers, no rank-3 Jacobian relayouts in relayout-clean programs,
+no unmanifested collectives in mesh programs, and no silent drift
+against the checked-in fingerprint manifests
+(``kafka_tpu/analysis/contracts/*.json``).
+
+Usage::
+
+    python -m tools.programlint                # analyze everything
+    python -m tools.programlint --programs date_twostream_inkernel
+    python -m tools.programlint --update       # accept drift deliberately
+    python -m tools.programlint --json         # machine-readable findings
+    python -m tools.programlint --list         # registered programs
+
+Exit codes: 0 clean, 1 findings, 2 usage/configuration error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def _ensure_cpu_jax() -> None:
+    """Force the CPU backend with a multi-device host platform BEFORE
+    jax initialises — analysis must never touch an accelerator, and the
+    mesh programs need >= 2 devices for a meaningful collective
+    inventory.  A no-op when jax is already imported (e.g. under pytest,
+    where conftest.py owns the environment)."""
+    if "jax" in sys.modules:
+        return
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.programlint",
+        description=(
+            "jaxpr/HLO-level contract analysis of registered device "
+            "programs (BASELINE.md 'Program contracts')"
+        ),
+    )
+    p.add_argument("--programs", default=None,
+                   help="comma-separated subset of registered programs")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable findings on stdout")
+    p.add_argument("--update", action="store_true",
+                   help="regenerate the contract manifests from the "
+                        "current traces (waivers preserved)")
+    p.add_argument("--list", action="store_true", dest="list_programs",
+                   help="print the registered programs and exit")
+    p.add_argument("--contracts-dir", default=None,
+                   help="manifest directory (default: "
+                        "kafka_tpu/analysis/contracts)")
+    p.add_argument("--no-manifest", action="store_true",
+                   help="skip manifest comparison (checkers only)")
+    p.add_argument("--no-collectives", action="store_true",
+                   help="skip the compile step that inventories "
+                        "collectives for mesh programs")
+    p.add_argument("--spec-module", default=None,
+                   help="import this module's REGISTRY instead of the "
+                        "production kafka_tpu.analysis.programs (the "
+                        "fixture tests use it)")
+    return p
+
+
+def _load_registry(spec_module: Optional[str]):
+    from kafka_tpu.analysis import registry as reg_mod
+
+    if spec_module is None:
+        from kafka_tpu.analysis import programs  # noqa: F401
+
+        return reg_mod.REGISTRY
+    mod = importlib.import_module(spec_module)
+    registry = getattr(mod, "REGISTRY", None)
+    if not isinstance(registry, dict) or not registry:
+        raise ValueError(
+            f"spec module {spec_module!r} exposes no non-empty "
+            "REGISTRY dict"
+        )
+    return registry
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    _ensure_cpu_jax()
+    args = build_parser().parse_args(argv)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+
+    from kafka_tpu import analysis
+
+    try:
+        registry = _load_registry(args.spec_module)
+        names = (
+            [n.strip() for n in args.programs.split(",") if n.strip()]
+            if args.programs else None
+        )
+        specs = analysis.get_specs(names, registry=registry)
+    except (ImportError, KeyError, ValueError) as exc:
+        print(f"programlint: {exc}", file=sys.stderr)
+        return 2
+
+    if args.list_programs:
+        for spec in specs:
+            extras = []
+            if spec.relayout_clean:
+                extras.append("relayout-clean")
+            if spec.collectives:
+                extras.append(
+                    "collectives=" + ",".join(spec.collectives)
+                )
+            suffix = f" [{'; '.join(extras)}]" if extras else ""
+            print(f"{spec.name}: {spec.description}{suffix}")
+        return 0
+
+    contracts_dir = (
+        None if args.no_manifest
+        else args.contracts_dir or analysis.contracts_dir()
+    )
+    result = analysis.analyze(
+        specs, contracts_dir=contracts_dir, update=args.update,
+        compile_collectives=not args.no_collectives,
+    )
+
+    if args.as_json:
+        payload = {
+            "version": 1,
+            "programs": result.reports,
+            "findings": [
+                {"program": f.program, "checker": f.checker,
+                 "message": f.message}
+                for f in result.findings
+            ],
+            "updated": result.updated,
+        }
+        json.dump(payload, sys.stdout, indent=2)
+        print()
+        return 0 if result.clean else 1
+
+    for f in result.findings:
+        print(f"programlint: {f.format()}", file=sys.stderr)
+    for path in result.updated:
+        print(f"programlint: wrote {os.path.relpath(path, repo_root)}")
+    if result.findings:
+        print(
+            f"programlint: {len(result.findings)} finding(s) across "
+            f"{len(specs)} program(s)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"programlint: clean ({len(specs)} programs, "
+        f"{sum(p['eqns'] for p in result.reports.values())} traced "
+        "equations)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
