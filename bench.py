@@ -343,6 +343,7 @@ def assemble_result(
     fused_lin=None,        # (px_s, ms_median, ms_spread) or None (off-TPU)
     serve=None,            # tools/loadgen rows dict or None
     fleet=None,            # tools/loadgen bench_fleet rows dict or None
+    sweep=None,            # tools/loadgen bench_concurrency_sweep dict or None
     smoother=None,         # bench_smoother_rows dict or None
     n_matched: int = 16384,
     n_device: int = 1 << 19,
@@ -487,6 +488,25 @@ def assemble_result(
         else serve.get("serve_slo_alerts_total"),
         "serve_slo_budget_remaining": None if serve is None
         else serve.get("serve_slo_budget_remaining"),
+        # Coalesced-serving rows (tools/loadgen.bench_concurrency_sweep,
+        # BASELINE.md "Coalesced serving"): the concurrency ladder with
+        # per-level p99/queue_wait/batch-size, the device launch
+        # throughput at the top level (serve_batched_px_s GATES in
+        # tools/bench_compare.py — disappearance or regression fails)
+        # and the unbatched same-run baseline the queue_wait shrink is
+        # measured against.
+        "serve_sweep": None if sweep is None
+        else sweep.get("serve_sweep"),
+        "serve_batched_px_s": None if sweep is None
+        else sweep.get("serve_batched_px_s"),
+        "serve_batch_mean_size": None if sweep is None
+        else sweep.get("serve_batch_mean_size"),
+        "serve_queue_wait_p99_ms": None if sweep is None
+        else sweep.get("serve_queue_wait_p99_ms"),
+        "serve_unbatched_p99_ms": None if sweep is None
+        else sweep.get("serve_unbatched_p99_ms"),
+        "serve_unbatched_queue_wait_p99_ms": None if sweep is None
+        else sweep.get("serve_unbatched_queue_wait_p99_ms"),
         # Elastic-fleet serving rows (tools/loadgen.bench_fleet: N
         # in-process replicas behind the consistent-hash router, one
         # client-visible serving surface).  serve_fleet_p50/p99_ms gate
@@ -751,6 +771,7 @@ def _bench_rows():
     smoother = bench_smoother_rows()
     serve = bench_serve_rows()
     fleet = bench_fleet_rows()
+    sweep = bench_sweep_rows()
     host_after_ms = probe_host()
     print(json.dumps(assemble_result(
         health,
@@ -762,6 +783,7 @@ def _bench_rows():
         e2e=e2e,
         serve=serve,
         fleet=fleet,
+        sweep=sweep,
         smoother=smoother,
         host_after_ms=host_after_ms,
         n_matched=n_matched,
@@ -856,6 +878,37 @@ def bench_serve_rows(requests: int = 24, concurrency: int = 4):
         return rows
     except Exception as exc:  # degrade to null rows: the serving bench must never cost the solve rows
         print(f"serve bench failed ({exc!r}) — serving rows null",
+              file=sys.stderr)
+        return None
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_sweep_rows(concurrencies=(1, 8, 32)):
+    """The coalesced-serving concurrency-sweep rows via
+    tools/loadgen's self-contained in-process harness (host-side
+    orchestration — meaningful on CPU and TPU alike).  Failure degrades
+    to null rows with a loud stderr note rather than killing the solve
+    rows."""
+    import shutil
+    import tempfile
+
+    from tools.loadgen import bench_concurrency_sweep
+
+    tmp = tempfile.mkdtemp(prefix="kafka_bench_sweep_")
+    try:
+        rows = bench_concurrency_sweep(tmp, concurrencies=concurrencies)
+        print(
+            f"serve sweep: batched px/s {rows['serve_batched_px_s']}, "
+            f"mean batch {rows['serve_batch_mean_size']} @ "
+            f"c={rows['serve_sweep_concurrencies'][-1]}, queue_wait "
+            f"p99 {rows['serve_queue_wait_p99_ms']} ms batched vs "
+            f"{rows['serve_unbatched_queue_wait_p99_ms']} ms unbatched",
+            file=sys.stderr,
+        )
+        return rows
+    except Exception as exc:  # degrade to null rows like the other serving benches
+        print(f"serve sweep failed ({exc!r}) — sweep rows null",
               file=sys.stderr)
         return None
     finally:

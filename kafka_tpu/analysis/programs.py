@@ -21,8 +21,10 @@ from .registry import BuiltProgram, register_program
 #: jitted/pallas def names whose compiled bodies are traced by the
 #: registered programs below (parsed by kafkalint rule 21 as a literal).
 COVERED_ENTRY_POINTS = {
-    # core/solvers.py — the per-date solve and the fused temporal scan.
+    # core/solvers.py — the per-date solve, its coalesced-serving twin
+    # (vmap over a leading member axis) and the fused temporal scan.
     "_assimilate_date_impl",
+    "_assimilate_batch_impl",
     "_assimilate_scan_impl",
     # core/pallas_solve.py — the packed solve and fused-update kernels
     # (traced inside the use_pallas date programs).
@@ -114,6 +116,44 @@ def _build_date_jac_to_rows():
         "use_pallas": True, "inkernel_linearize": False,
         "max_iterations": 5,
     })
+
+
+@register_program(
+    "date_batched_twostream_xla",
+    description="assimilate_date_batch_jit: K=4 coalesced serve "
+                "members (vmap over the leading member axis; each "
+                "member's slice bit-identical to a solo date solve)",
+)
+def _build_date_batched_xla():
+    from ..core.solvers import (
+        assimilate_date_batch_jit, stack_solver_options,
+    )
+    from ..core.types import BandBatch
+    from ..obsops.twostream import TwoStreamOperator
+
+    k = 4
+    op = TwoStreamOperator()
+    obs = BandBatch(
+        y=_sds((k, TIP_BANDS, N_PIX)),
+        r_inv=_sds((k, TIP_BANDS, N_PIX)),
+        mask=_sds((k, TIP_BANDS, N_PIX), "bool"),
+    )
+    x = _sds((k, N_PIX, TIP_P))
+    p_inv = _sds((k, N_PIX, TIP_P, TIP_P))
+    # Per-member numeric leaves stack to (K,) exactly as the serving
+    # executor's stack_solver_options produces them.
+    opts = stack_solver_options([
+        {"use_pallas": False, "max_iterations": 5,
+         "norm_denominator": float(N_PIX * (1 + i))}
+        for i in range(k)
+    ])
+
+    def run(obs, x, p_inv):
+        return assimilate_date_batch_jit(
+            op.linearize, obs, x, p_inv, None, opts
+        )
+
+    return run, (obs, x, p_inv)
 
 
 def _scan_program(solver_options, k_windows=3):

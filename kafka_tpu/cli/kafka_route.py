@@ -100,6 +100,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "0 = disabled)")
     ap.add_argument("--live-interval-s", type=float, default=None,
                     help="live_<host>_<pid>.json heartbeat cadence")
+    ap.add_argument("--compile-cache-dir", default=None,
+                    help="persistent XLA compilation cache directory "
+                         "(default: <root>/.xla_cache; the router "
+                         "itself compiles nothing, but keeping the "
+                         "flag uniform lets one wrapper script "
+                         "configure the whole fleet)")
     add_telemetry_arg(ap)
     ap.add_argument("--verbose", action="store_true")
     return ap
@@ -109,6 +115,12 @@ def main(argv=None):
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING
+    )
+    from ..utils.compilation_cache import enable_compilation_cache
+
+    enable_compilation_cache(
+        cache_dir=(args.compile_cache_dir
+                   or os.path.join(args.root, ".xla_cache")),
     )
     if not args.replicas and not args.replicas_file:
         print("kafka-route: need --replicas and/or --replicas-file",

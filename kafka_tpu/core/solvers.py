@@ -1144,24 +1144,206 @@ def assimilate_date_jit(
     arguments here.
     """
     opts = dict(solver_options or {})
+    statics = _split_structural_options(opts)
+    # solver.pixel chaos hook (host-side check; None when disarmed — the
+    # production compiled program carries no corruption argument).
+    corrupt = solver_health.corruption_mask(x_forecast.shape[0])
+    return _assimilate_date_impl(
+        linearize, obs, x_forecast, p_inv_forecast, operator_params,
+        opts or None, hessian_forward, *statics,
+        None if corrupt is None else jnp.asarray(corrupt, jnp.float32),
+    )
+
+
+# Option keys that change the compiled program's STRUCTURE (shape, kernel
+# choice, loop trip count) rather than riding it as traced data.  Batch
+# members must agree on all of them — they become the bucket's statics.
+STRUCTURAL_OPTION_KEYS = (
+    "linearize_block", "use_pallas", "per_pixel_convergence",
+    "inkernel_linearize", "min_iterations", "max_iterations",
+)
+
+
+def _split_structural_options(opts: dict):
+    """Pop the structural options out of ``opts`` (mutated in place,
+    leaving only traced numeric leaves) and return them normalised in
+    ``_assimilate_date_impl`` static-argument order."""
     block = opts.pop("linearize_block", None)
     use_pallas = bool(opts.pop("use_pallas", False))
     inkernel = bool(opts.pop("inkernel_linearize", True))
     per_pixel = bool(opts.pop("per_pixel_convergence", False))
     min_it = opts.pop("min_iterations", None)
     max_it = opts.pop("max_iterations", None)
-    # solver.pixel chaos hook (host-side check; None when disarmed — the
-    # production compiled program carries no corruption argument).
-    corrupt = solver_health.corruption_mask(x_forecast.shape[0])
-    return _assimilate_date_impl(
-        linearize, obs, x_forecast, p_inv_forecast, operator_params,
-        opts or None, hessian_forward,
+    return (
         None if block is None else int(block),
         use_pallas, per_pixel, inkernel,
         None if min_it is None else int(min_it),
         None if max_it is None else int(max_it),
+    )
+
+
+def structural_options(solver_options) -> tuple:
+    """The structural-option fingerprint of an option dict (normalised,
+    fixed order) — the piece of a serve shape bucket key that comes from
+    solver options.  Does not mutate the input."""
+    return _split_structural_options(dict(solver_options or {}))
+
+
+def stack_solver_options(options_list):
+    """Merge per-member solver-option dicts into ONE batched dict for
+    ``assimilate_date_batch_jit``: structural options must agree across
+    members (they shape the compiled program) and pass through as plain
+    values; every numeric leaf gains a leading member axis via
+    ``jnp.stack`` so each vmapped member sees exactly its own value.
+
+    Raises ``ValueError`` when members disagree structurally or carry
+    different option keys — such requests belong to different shape
+    buckets and must not share a launch.
+    """
+    dicts = [dict(o or {}) for o in options_list]
+    statics = [_split_structural_options(d) for d in dicts]
+    if any(s != statics[0] for s in statics[1:]):
+        raise ValueError(
+            "batch members disagree on structural solver options: "
+            f"{[s for s in statics]}"
+        )
+    keys = sorted(dicts[0])
+    if any(sorted(d) != keys for d in dicts[1:]):
+        raise ValueError(
+            "batch members carry different solver-option keys: "
+            f"{[sorted(d) for d in dicts]}"
+        )
+    out = {}
+    for k in keys:
+        out[k] = jax.tree.map(
+            lambda *leaves: jnp.stack([jnp.asarray(v) for v in leaves]),
+            *[d[k] for d in dicts],
+        )
+    for key, value in zip(STRUCTURAL_OPTION_KEYS, statics[0]):
+        if value is not None:
+            out[key] = value
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=(0, 6, 7, 8, 9, 10, 11, 12))
+def _assimilate_batch_impl(
+    linearize: LinearizeFn,
+    obs: BandBatch,
+    x_forecast: jnp.ndarray,
+    p_inv_forecast: jnp.ndarray,
+    operator_params: Any,
+    solver_options: Any,
+    hessian_forward: Any,
+    linearize_block: Any,
+    use_pallas: bool,
+    per_pixel_convergence: bool,
+    inkernel_linearize: bool,
+    min_iterations: Any,
+    max_iterations: Any,
+    corrupt: Any = None,
+):
+    def _member(obs_m, x_m, p_inv_m, params_m, opts_m, corrupt_m):
+        opts = dict(opts_m or {})
+        if min_iterations is not None:
+            opts["min_iterations"] = min_iterations
+        if max_iterations is not None:
+            opts["max_iterations"] = max_iterations
+        return iterated_solve(
+            linearize, obs_m, x_m, p_inv_m, params_m,
+            hessian_forward=hessian_forward,
+            linearize_block=linearize_block, use_pallas=use_pallas,
+            per_pixel_convergence=per_pixel_convergence,
+            inkernel_linearize=inkernel_linearize, corrupt=corrupt_m,
+            **opts,
+        )
+
+    in_axes = (
+        0, 0, 0,
+        None if operator_params is None else 0,
+        None if not solver_options else 0,
+        None if corrupt is None else 0,
+    )
+    return jax.vmap(_member, in_axes=in_axes)(
+        obs, x_forecast, p_inv_forecast, operator_params,
+        solver_options, corrupt,
+    )
+
+
+def assimilate_date_batch_jit(
+    linearize: LinearizeFn,
+    obs: BandBatch,
+    x_forecast: jnp.ndarray,
+    p_inv_forecast: jnp.ndarray,
+    operator_params: Any = None,
+    solver_options: Any = None,
+    hessian_forward: Any = None,
+    corrupt: Any = None,
+):
+    """Coalesced-serving twin of :func:`assimilate_date_jit`: K compatible
+    members stacked on a leading axis ride ONE launch.
+
+    Every traced argument carries a leading member axis K: ``obs`` leaves
+    are (K, n_bands, n_pad), states (K, n_pad, p), information matrices
+    (K, n_pad, ...), ``operator_params`` leaves stacked leaf-wise (or
+    None when every member's aux is None).  ``solver_options`` is a
+    *batched* dict as produced by :func:`stack_solver_options` — numeric
+    leaves stacked to (K, ...), structural options plain and shared.
+
+    The batching is ``jax.vmap`` over members, NOT pixel concatenation:
+    each member keeps its own convergence norm, its own iteration count
+    (the batched ``lax.while_loop`` freezes finished members via select)
+    and its own ``norm_denominator`` — so each member's (n_pad, p) output
+    slice is bit-identical to what a solo ``assimilate_date_jit`` call
+    would have produced.  Diagnostics come back member-stacked too.
+
+    ``corrupt``, when given, is a (K, n_pix) mask — rows of zeros leave
+    their member untouched (``where`` against an all-False row is the
+    identity), so a batch may mix armed and unarmed members.
+    """
+    opts = dict(solver_options or {})
+    statics = _split_structural_options(opts)
+    return _assimilate_batch_impl(
+        linearize, obs, x_forecast, p_inv_forecast, operator_params,
+        opts or None, hessian_forward, *statics,
         None if corrupt is None else jnp.asarray(corrupt, jnp.float32),
     )
+
+
+def lower_date_program(
+    linearize: LinearizeFn,
+    obs: BandBatch,
+    x_forecast: jnp.ndarray,
+    p_inv_forecast: jnp.ndarray,
+    operator_params: Any = None,
+    solver_options: Any = None,
+    hessian_forward: Any = None,
+    batch_size: Any = None,
+):
+    """Ahead-of-time ``lower().compile()`` of one serve shape bucket.
+
+    Called with *representative concrete arguments* (zeros of the
+    bucket's exact shapes, the bucket's real option dict — concrete
+    Python floats lower to the same weak-typed avals the live dispatch
+    traces) so the compiled executable lands in the persistent XLA
+    compilation cache NOW; the first live request against this bucket
+    then pays a cache hit instead of a compile.  ``batch_size=None``
+    lowers the solo per-date program, an integer K lowers the K-member
+    batched program (arguments must already carry the leading K axis).
+
+    Returns the ``jax.stages.Compiled`` object (useful for memory
+    analysis); the side effect on the compilation cache is the point.
+    """
+    opts = dict(solver_options or {})
+    statics = _split_structural_options(opts)
+    target = (
+        _assimilate_date_impl if batch_size is None
+        else _assimilate_batch_impl
+    )
+    lowered = target.lower(
+        linearize, obs, x_forecast, p_inv_forecast, operator_params,
+        opts or None, hessian_forward, *statics, None,
+    )
+    return lowered.compile()
 
 
 class ScanWindowStats(NamedTuple):

@@ -183,6 +183,15 @@ class KalmanFilter:
         # Fused blocks count as their window span and save at block end.
         self.checkpoint_every_n = max(1, int(checkpoint_every_n))
         self._windows_since_ckpt = 0
+        # Per-date dispatch hook: the serving layer's batch executor
+        # points this at its rendezvous so compatible concurrent serves
+        # coalesce into one stacked launch (serve.batch).  None (the
+        # default, and every non-serving path) dispatches
+        # ``assimilate_date_jit`` directly — same signature, same
+        # program.  Only the unfused scan_window=1 joint-band path
+        # honours it; fused scans and band-sequential keep their own
+        # launches.
+        self.date_dispatcher = None
         self.diagnostics = diagnostics
         self.diagnostics_log: list = []
         # Identity trajectory model + zero model error by default, matching
@@ -389,6 +398,33 @@ class KalmanFilter:
                 "aborting (systemic read outage, not transient weather)"
             ) from exc
 
+    def date_solver_options(self, operator) -> dict:
+        """The per-date solver-option dict EXACTLY as the time loop
+        dispatches it — also the source of truth for serve-side AOT
+        bucket lowering (``core.solvers.lower_date_program``), which must
+        trace the same program the live dispatch will."""
+        opts = dict(self.solver_options or {})
+        if "state_bounds" not in opts and \
+                getattr(operator, "state_bounds", None) is not None:
+            lo, hi = operator.state_bounds
+            opts["state_bounds"] = (
+                jnp.asarray(lo, jnp.float32), jnp.asarray(hi, jnp.float32)
+            )
+        # Convergence tolerance must be measured on valid pixels only.
+        opts.setdefault(
+            "norm_denominator",
+            float(self.gather.n_valid * self.n_params),
+        )
+        # Bound solver peak memory on big batches: linearise in
+        # sequential 256k-pixel blocks (the batched value+Jacobian is
+        # ~11 KB/px of live intermediates for deep operators — without
+        # blocking, ~1.4M px exhausts a 16 GB chip).  Harmless when
+        # the in-kernel-linearise path engages: that path is
+        # O(kernel block) memory by construction and ignores this.
+        if self.gather.n_pad > 262144:
+            opts.setdefault("linearize_block", 262144)
+        return opts
+
     def assimilate_dates(self, dates, x_forecast, p_forecast,
                          p_forecast_inverse):
         """Assimilate each acquisition in the window sequentially, posterior
@@ -416,26 +452,7 @@ class KalmanFilter:
             # buffer census (telemetry.devprof OOM forensics).
             faults.fault_point("device.oom", date=str(date))
             t0 = time.time()
-            opts = dict(self.solver_options or {})
-            if "state_bounds" not in opts and \
-                    getattr(obs.operator, "state_bounds", None) is not None:
-                lo, hi = obs.operator.state_bounds
-                opts["state_bounds"] = (
-                    jnp.asarray(lo, jnp.float32), jnp.asarray(hi, jnp.float32)
-                )
-            # Convergence tolerance must be measured on valid pixels only.
-            opts.setdefault(
-                "norm_denominator",
-                float(self.gather.n_valid * self.n_params),
-            )
-            # Bound solver peak memory on big batches: linearise in
-            # sequential 256k-pixel blocks (the batched value+Jacobian is
-            # ~11 KB/px of live intermediates for deep operators — without
-            # blocking, ~1.4M px exhausts a 16 GB chip).  Harmless when
-            # the in-kernel-linearise path engages: that path is
-            # O(kernel block) memory by construction and ignores this.
-            if self.gather.n_pad > 262144:
-                opts.setdefault("linearize_block", 262144)
+            opts = self.date_solver_options(obs.operator)
             if self.band_sequential:
                 x_a, p_inv_a, diags = self._assimilate_band_sequential(
                     obs, x_a, p_inv_a, opts
@@ -446,7 +463,8 @@ class KalmanFilter:
                     hess_fwd = getattr(
                         obs.operator, "forward_pixel", None
                     )
-                x_a, p_inv_a, diags = assimilate_date_jit(
+                dispatch = self.date_dispatcher or assimilate_date_jit
+                x_a, p_inv_a, diags = dispatch(
                     obs.operator.linearize, obs.bands, x_a,
                     p_inv_a, obs.aux, opts or None, hess_fwd,
                 )

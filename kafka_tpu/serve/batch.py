@@ -1,0 +1,497 @@
+"""Coalesced serving: the sanctioned batch executor.
+
+This module is the ONE place the serving layer may dispatch solve work
+(kafkalint rule 22 ``unbatched-serve-dispatch``): every
+``TileSession.serve`` call and every per-date device dispatch on the
+serve path funnels through here, so batching semantics — and their
+bit-identity guarantee — cannot be bypassed by a new call site.
+
+The coalescing design (BASELINE.md "Coalesced serving"):
+
+Admission groups compatible queued requests by COARSE shape bucket
+(:func:`probe_bucket`): padded pixel-batch size ``n_pad``, parameter
+count ``p``, band count, structural solver options and the operator
+fingerprint.  The service then runs each member's FULL serve pipeline
+concurrently (one thread per member, distinct tiles only — sessions are
+not thread-safe), with the engine's per-date dispatch replaced by a
+rendezvous post (:class:`_Rendezvous`).  When every live member has
+posted, the last poster executes the round: posts with identical EXACT
+keys (argument avals + statics) ride one stacked
+``core.solvers.assimilate_date_batch_jit`` launch — a ``vmap`` over the
+member axis, NOT pixel concatenation, so each member keeps its own
+convergence norm and iteration count and its output slice is
+bit-identical to a solo ``assimilate_date_jit`` call.  Posts that don't
+group (cold/warm members mid-run on different windows, odd shapes)
+execute solo through the member's own unbatched program.
+
+Membership is dynamic: a member leaves on finish or error (a poison
+request errors alone — its peers simply rendezvous without it), and a
+leave triggers execution when everyone still in is already posted.
+Members whose serve runs more windows than their peers' keep posting
+after the others left and finish on plain solo launches.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import solver_health, solvers
+from ..telemetry import get_registry
+
+LOG = logging.getLogger(__name__)
+
+
+def _batch_metrics(reg):
+    """Rendezvous-level launch counters (the one owning site)."""
+    return {
+        "launches": reg.counter(
+            "kafka_serve_batch_launches_total",
+            "device launches issued by the serve batch executor's "
+            "rendezvous (coalesced and solo rounds alike)",
+        ),
+        "launch_members": reg.counter(
+            "kafka_serve_batch_launch_members_total",
+            "solve members carried by rendezvous launches — divided by "
+            "launches this is the mean device-level batch size",
+        ),
+        "coalesced": reg.counter(
+            "kafka_serve_batch_coalesced_total",
+            "rendezvous launches that stacked two or more members into "
+            "one vmapped device program",
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+# shape buckets
+# ---------------------------------------------------------------------------
+
+class ShapeBucket:
+    """One tile's serve-compatibility fingerprint plus the
+    representative pieces AOT lowering needs.  Two sessions whose
+    buckets share ``key`` may coalesce; ``linearize``/``hessian_forward``
+    are the bucket's canonical statics (functionally identical across
+    the bucket's tiles by construction of the key), so every coalesced
+    launch of the bucket compiles exactly once."""
+
+    def __init__(self, key, n_pad, p, n_bands, linearize,
+                 hessian_forward, solver_options, example):
+        self.key = key
+        self.n_pad = int(n_pad)
+        self.p = int(p)
+        self.n_bands = int(n_bands)
+        self.linearize = linearize
+        self.hessian_forward = hessian_forward
+        #: the per-date option dict exactly as the engine dispatches it
+        self.solver_options = solver_options
+        #: (bands, x0, p_inv0, aux) — representative concrete arguments
+        self.example = example
+
+    def describe(self) -> dict:
+        return {
+            "n_pad": self.n_pad, "p": self.p, "n_bands": self.n_bands,
+            "options": sorted(
+                k for k in (self.solver_options or {})
+            ),
+        }
+
+
+def _operator_fingerprint(op) -> tuple:
+    """A conservative value fingerprint of an observation operator:
+    equal fingerprints mean functionally identical operators (safe to
+    share one compiled program); attributes the fingerprint cannot
+    inspect make the operator unique — preventing coalescing rather
+    than risking a wrong shared program.  Operators may override via a
+    ``serve_bucket_token()`` method."""
+    token = getattr(op, "serve_bucket_token", None)
+    if callable(token):
+        return ("token", type(op).__module__, type(op).__qualname__,
+                token())
+    parts: List[Any] = [type(op).__module__, type(op).__qualname__]
+    for k in sorted(vars(op) or {}):
+        v = vars(op)[k]
+        if isinstance(v, (bool, int, float, str, bytes, type(None))):
+            parts.append((k, v))
+        elif isinstance(v, (tuple, list)) and all(
+                isinstance(e, (bool, int, float, str)) for e in v):
+            parts.append((k, tuple(v)))
+        elif isinstance(v, (np.ndarray, jnp.ndarray)):
+            a = np.asarray(v)
+            parts.append((k, a.shape, str(a.dtype),
+                          hashlib.sha256(a.tobytes()).hexdigest()))
+        else:
+            # Opaque attribute: fall back to instance identity — this
+            # operator only ever buckets with itself.
+            parts.append((k, f"id:{id(v)}"))
+    return tuple(parts)
+
+
+def probe_bucket(session) -> Optional[ShapeBucket]:
+    """Derive a session's :class:`ShapeBucket` from one throwaway
+    filter, or ``None`` when the tile cannot coalesce: fused scan
+    windows and band-sequential loops keep their own launch structure,
+    Pallas kernel paths are excluded (no batching rule), and duck-typed
+    sessions without a real ``TileSpec`` serve unbatched."""
+    spec = getattr(session, "spec", None)
+    make = getattr(spec, "make_filter", None)
+    if make is None:
+        return None
+    kf, x0, p_inv0, output = make()
+    try:
+        if getattr(kf, "scan_window", 1) != 1:
+            return None
+        if getattr(kf, "band_sequential", False):
+            return None
+        dates = list(kf.observations.dates)
+        if not dates:
+            return None
+        obs = kf.observations.get_observations(dates[0], kf.gather)
+        opts = kf.date_solver_options(obs.operator)
+        statics = solvers.structural_options(opts)
+        use_pallas = statics[1]
+        if use_pallas:
+            return None
+        hess = None
+        if kf.hessian_correction:
+            hess = getattr(obs.operator, "forward_pixel", None)
+        key = (
+            kf.gather.n_pad, kf.n_params, obs.operator.n_bands,
+            _operator_fingerprint(obs.operator), statics,
+            tuple(sorted(
+                k for k in opts
+                if k not in solvers.STRUCTURAL_OPTION_KEYS
+            )),
+            bool(kf.hessian_correction),
+        )
+        return ShapeBucket(
+            key=key, n_pad=kf.gather.n_pad, p=kf.n_params,
+            n_bands=obs.operator.n_bands,
+            linearize=obs.operator.linearize, hessian_forward=hess,
+            solver_options=opts,
+            example=(obs.bands, x0, p_inv0, obs.aux),
+        )
+    finally:
+        close = getattr(output, "close", None)
+        if close is not None:
+            close()
+
+
+def session_bucket_key(session):
+    """The coarse compatibility key the admission micro-window groups
+    on, or ``None`` when the session cannot coalesce."""
+    get = getattr(session, "serve_bucket", None)
+    if get is None:
+        return None
+    bucket = get()
+    return None if bucket is None else bucket.key
+
+
+# ---------------------------------------------------------------------------
+# the sanctioned serve call-through
+# ---------------------------------------------------------------------------
+
+def solve_session(session, date, smoothed: bool = False,
+                  dispatcher=None) -> dict:
+    """THE serve-solve entry point (kafkalint rule 22): the service's
+    singleton path and every batch member funnel through here.  Plain
+    calls keep the duck-typed ``serve(date)`` signature stubs rely on;
+    only batch members pass a dispatcher."""
+    if smoothed:
+        return session.serve(date, smoothed=True)
+    if dispatcher is None:
+        return session.serve(date)
+    return session.serve(date, dispatcher=dispatcher)
+
+
+# ---------------------------------------------------------------------------
+# the rendezvous
+# ---------------------------------------------------------------------------
+
+class _Post:
+    """One member's blocked per-date dispatch."""
+
+    __slots__ = ("linearize", "obs", "x", "p_inv", "aux", "opts",
+                 "hess", "corrupt", "done", "result", "error")
+
+    def __init__(self, linearize, obs, x, p_inv, aux, opts, hess,
+                 corrupt):
+        self.linearize = linearize
+        self.obs = obs
+        self.x = x
+        self.p_inv = p_inv
+        self.aux = aux
+        self.opts = opts
+        self.hess = hess
+        self.corrupt = corrupt
+        self.done = False
+        self.result = None
+        self.error = None
+
+    def exact_key(self) -> tuple:
+        """Stackability: identical avals + statics + option keys."""
+        def avals(tree):
+            leaves, treedef = jax.tree.flatten(tree)
+            return (
+                str(treedef),
+                tuple((tuple(np.shape(leaf)),
+                       str(jnp.result_type(leaf))) for leaf in leaves),
+            )
+
+        opts = dict(self.opts or {})
+        statics = solvers.structural_options(opts)
+        return (
+            avals(self.obs), avals(self.x), avals(self.p_inv),
+            avals(self.aux), statics, avals(opts),
+            self.corrupt is None,
+        )
+
+
+class _Rendezvous:
+    """Barrier-cycle meeting point for one admitted batch: members post
+    per-date dispatches; when every live member has posted, the last
+    poster (or the last leaver) executes the round and wakes everyone
+    with their own slice."""
+
+    def __init__(self, executor: "BatchExecutor", size: int):
+        self._executor = executor
+        self._cond = threading.Condition()
+        self._active = size
+        self._posted: Dict[int, _Post] = {}
+
+    def post(self, index: int, post: _Post):
+        with self._cond:
+            self._posted[index] = post
+            if len(self._posted) >= self._active:
+                self._execute_locked()
+            else:
+                while not post.done:
+                    self._cond.wait()
+        if post.error is not None:
+            raise post.error
+        return post.result
+
+    def leave(self, index: int) -> None:
+        with self._cond:
+            self._active -= 1
+            self._posted.pop(index, None)
+            if self._posted and len(self._posted) >= self._active:
+                self._execute_locked()
+
+    # -- execution (condition lock held; every live member is parked) --
+
+    def _execute_locked(self) -> None:
+        posts = self._posted
+        self._posted = {}
+        groups: Dict[tuple, List[_Post]] = {}
+        for index in sorted(posts):
+            p = posts[index]
+            groups.setdefault(p.exact_key(), []).append(p)
+        for key, group in groups.items():
+            try:
+                self._launch(key, group)
+            except BaseException as exc:  # noqa: B036 — delivered to members
+                for p in group:
+                    p.error = exc
+                    p.done = True
+        self._cond.notify_all()
+
+    def _launch(self, key: tuple, group: List[_Post]) -> None:
+        metrics = self._executor.metrics()
+        t0 = time.perf_counter()
+        if len(group) == 1:
+            p = group[0]
+            # Solo round: the member's own unbatched program — the
+            # exact dispatch a dispatcher-less serve would have made.
+            p.result = solvers.assimilate_date_jit(
+                p.linearize, p.obs, p.x, p.p_inv, p.aux, p.opts,
+                p.hess,
+            ) + (t0, time.perf_counter(), 1)
+            p.done = True
+        else:
+            lin, hess = self._executor.canonical_statics(key, group[0])
+            bands = jax.tree.map(
+                lambda *ls: jnp.stack(ls), *[p.obs for p in group]
+            )
+            xs = jnp.stack([p.x for p in group])
+            pis = jnp.stack([p.p_inv for p in group])
+            aux = None
+            if group[0].aux is not None:
+                aux = jax.tree.map(
+                    lambda *ls: jnp.stack(ls), *[p.aux for p in group]
+                )
+            bopts = solvers.stack_solver_options(
+                [p.opts for p in group]
+            )
+            corrupt = None
+            if any(p.corrupt is not None for p in group):
+                n_pix = group[0].x.shape[0]
+                corrupt = jnp.stack([
+                    jnp.zeros((n_pix,), jnp.float32) if p.corrupt is None
+                    else jnp.asarray(p.corrupt, jnp.float32)
+                    for p in group
+                ])
+            xb, pib, diagb = solvers.assimilate_date_batch_jit(
+                lin, bands, xs, pis, aux, bopts, hess, corrupt,
+            )
+            t1 = time.perf_counter()
+            for i, p in enumerate(group):
+                p.result = (
+                    xb[i], pib[i],
+                    jax.tree.map(lambda leaf: leaf[i], diagb),
+                    t0, t1, len(group),
+                )
+                p.done = True
+            metrics["coalesced"].inc()
+        metrics["launches"].inc()
+        metrics["launch_members"].inc(len(group))
+
+
+class _Member:
+    """One request's handle on a rendezvous: provides the engine
+    dispatcher and the obligatory ``close()`` (idempotent; call it in a
+    ``finally`` — success, error and cache-hit paths alike)."""
+
+    def __init__(self, rendezvous: _Rendezvous, index: int):
+        self._rendezvous = rendezvous
+        self._index = index
+        self._closed = False
+        #: set by the service on the member's first (and only) batched
+        #: solve attempt — retries run solo, after the member left.
+        self.used = False
+        #: (t_start, t_end) of every coalesced launch this member rode
+        self.batch_spans: List[tuple] = []
+        #: member counts of those launches
+        self.launch_sizes: List[int] = []
+
+    def dispatcher(self):
+        """An ``assimilate_date_jit``-shaped callable that posts to the
+        rendezvous instead of launching directly."""
+
+        def dispatch(linearize, obs, x, p_inv, aux, opts, hess):
+            # solver.pixel chaos hook: host-side, per member, at the
+            # same point the solo path evaluates it.
+            corrupt = solver_health.corruption_mask(x.shape[0])
+            post = _Post(linearize, obs, x, p_inv, aux,
+                         dict(opts or {}), hess, corrupt)
+            x_a, p_inv_a, diags, t0, t1, size = \
+                self._rendezvous.post(self._index, post)
+            if size > 1:
+                self.batch_spans.append((t0, t1))
+                self.launch_sizes.append(size)
+                get_registry().trace.add_span(
+                    "serve_batch", t0, t1, cat="phase", members=size,
+                )
+            return x_a, p_inv_a, diags
+
+        return dispatch
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._rendezvous.leave(self._index)
+
+
+class BatchExecutor:
+    """Factory for rendezvous batches + the process-wide canonical
+    statics per exact key (one compiled batched program per bucket and
+    batch size, however the member order shook out)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._canonical: Dict[tuple, tuple] = {}
+        self._metrics = None
+
+    def metrics(self):
+        with self._lock:
+            if self._metrics is None:
+                self._metrics = _batch_metrics(get_registry())
+            return self._metrics
+
+    def reset_metrics(self) -> None:
+        """Re-bind counters after a registry swap (tests)."""
+        with self._lock:
+            self._metrics = None
+
+    def canonical_statics(self, key: tuple, post: _Post) -> tuple:
+        with self._lock:
+            if key not in self._canonical:
+                self._canonical[key] = (post.linearize, post.hess)
+            return self._canonical[key]
+
+    def open(self, size: int) -> List[_Member]:
+        """A fresh rendezvous with ``size`` member handles."""
+        rendezvous = _Rendezvous(self, size)
+        return [_Member(rendezvous, i) for i in range(size)]
+
+
+# ---------------------------------------------------------------------------
+# AOT bucket compilation
+# ---------------------------------------------------------------------------
+
+def aot_compile_buckets(sessions: dict, batch_sizes=(1,)) -> dict:
+    """Ahead-of-time compile every distinct shape bucket among the
+    resident tiles (daemon start): for each bucket, lower + compile the
+    solo per-date program and the requested batched member counts with
+    representative concrete arguments, landing the executables in the
+    persistent XLA compilation cache — the first live request (and the
+    first coalesced launch) then pays a cache hit, not a compile.
+
+    Returns the ``serve_aot_buckets`` status fact: one entry per
+    distinct bucket with its tiles, shapes and compile wall time.
+    """
+    buckets: Dict[tuple, dict] = {}
+    for name in sorted(sessions):
+        get = getattr(sessions[name], "serve_bucket", None)
+        bucket = get() if get is not None else None
+        if bucket is None:
+            continue
+        if bucket.key in buckets:
+            buckets[bucket.key]["tiles"].append(name)
+            continue
+        bands, x0, p_inv0, aux = bucket.example
+        t0 = time.perf_counter()
+        for k in sorted(set(int(k) for k in batch_sizes)):
+            if k <= 0:
+                continue
+            if k == 1:
+                solvers.lower_date_program(
+                    bucket.linearize, bands, x0, p_inv0, aux,
+                    dict(bucket.solver_options),
+                    bucket.hessian_forward,
+                )
+            else:
+                stack = lambda tree: jax.tree.map(  # noqa: E731
+                    lambda leaf: jnp.stack([leaf] * k), tree
+                )
+                solvers.lower_date_program(
+                    bucket.linearize, stack(bands), stack(x0),
+                    stack(p_inv0),
+                    None if aux is None else stack(aux),
+                    solvers.stack_solver_options(
+                        [dict(bucket.solver_options)] * k
+                    ),
+                    bucket.hessian_forward, batch_size=k,
+                )
+        entry = dict(bucket.describe())
+        entry.update(
+            tiles=[name],
+            batch_sizes=sorted(
+                int(k) for k in set(batch_sizes) if int(k) > 0
+            ),
+            compile_ms=round((time.perf_counter() - t0) * 1e3, 3),
+        )
+        buckets[bucket.key] = entry
+    out = list(buckets.values())
+    LOG.info(
+        "AOT-compiled %d serve shape bucket(s) covering %d tile(s)",
+        len(out), sum(len(e["tiles"]) for e in out),
+    )
+    return {"count": len(out), "buckets": out}

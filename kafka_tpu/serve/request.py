@@ -71,6 +71,11 @@ class ServeRequest:
     #: reanalysis request kind: answer with the RTS-smoothed state from
     #: the checkpoint chain instead of the live filter analysis.
     smoothed: bool = False
+    #: coalesced-serving stamps, set by the worker when this request was
+    #: served as a member of an admission micro-batch (process-local;
+    #: they ride the response trace and the request_log wide event).
+    batch_id: Optional[str] = None
+    batch_size: Optional[int] = None
 
     def payload(self) -> dict:
         """The journal line (and the client-visible echo)."""

@@ -47,6 +47,7 @@ from ..resilience import (
 from ..telemetry import get_registry, tracing
 from ..telemetry import request_log
 from ..telemetry.tracing import trace_span
+from . import batch as batching
 from .admission import AdmissionController, AdmissionPolicy
 from .journal import RequestJournal
 from .request import BadRequest, ServeRequest, parse_request
@@ -107,6 +108,15 @@ def _serve_metrics(reg):
             "kafka_serve_latency_seconds",
             "submit-to-response seconds for OK-served requests",
         ),
+        "batches": reg.counter(
+            "kafka_serve_batches_total",
+            "micro-window admission groups of two or more compatible "
+            "requests handed to the batch executor together",
+        ),
+        "batch_requests": reg.counter(
+            "kafka_serve_batch_requests_total",
+            "requests served as members of a coalesced admission group",
+        ),
     }
 
 
@@ -123,6 +133,8 @@ class AssimilationService:
         result_cache_size: int = 256,
         journal_rotate_bytes: Optional[int] = None,
         journal_keep: int = 3,
+        batch_window_ms: float = 0.0,
+        max_batch: int = 8,
     ):
         self.sessions = dict(sessions)
         self.journal = RequestJournal(
@@ -132,7 +144,15 @@ class AssimilationService:
         self.default_deadline_s = default_deadline_s
         self._retry = retry_policy if retry_policy is not None \
             else DEFAULT_SERVE_RETRY
+        # Coalesced serving (BASELINE.md "Coalesced serving"): 0 ms
+        # keeps the classic one-at-a-time worker; a positive window
+        # lets the worker hold a dequeued request up to this long while
+        # compatible peers arrive, then serves the group as one batch.
+        self._batch_window_s = max(0.0, float(batch_window_ms)) / 1e3
+        self._max_batch = max(1, int(max_batch))
+        self._executor = batching.BatchExecutor()
         self._cache: "collections.OrderedDict" = collections.OrderedDict()
+        self._cache_lock = threading.Lock()
         self._cache_size = int(result_cache_size)
         self._queue: "collections.deque[ServeRequest]" = collections.deque()
         self._cond = threading.Condition()
@@ -209,8 +229,13 @@ class AssimilationService:
     def stop_admitting(self) -> None:
         """Flip new submissions to ``rejected: draining`` immediately
         (the drain's first half, split out so the daemon can answer
-        latecomers with explicit rejections before the final wait)."""
+        latecomers with explicit rejections before the final wait).
+        Also wakes the worker: a partially-filled batch window must
+        flush NOW — no admitted request sits out the micro-window once
+        the drain started."""
         self._draining.set()
+        with self._cond:
+            self._cond.notify_all()
 
     def drain(self, timeout_s: Optional[float] = None) -> bool:
         """SIGTERM semantics: reject new work, finish everything already
@@ -228,6 +253,13 @@ class AssimilationService:
                     return False
                 self._cond.wait(timeout=wait if wait is not None else 1.0)
         return True
+
+    def set_batch_window(self, batch_window_ms: float) -> None:
+        """Re-tune the admission micro-window live (0 disables
+        coalescing).  Used by the bench harness to measure batched and
+        unbatched serving in ONE run against the same warm sessions."""
+        with self._cond:
+            self._batch_window_s = max(0.0, float(batch_window_ms)) / 1e3
 
     def pending(self) -> int:
         with self._cond:
@@ -354,11 +386,109 @@ class AssimilationService:
                 self._busy = True
                 self._set_depth_locked()
             try:
-                self._process(req)
+                group = self._collect_batch(req)
+                if len(group) == 1:
+                    self._process(req)
+                else:
+                    self._process_batch(group)
             finally:
                 with self._cond:
                     self._busy = False
                     self._cond.notify_all()
+
+    def _collect_batch(self, head: ServeRequest) -> list:
+        """The admission micro-window: hold the dequeued ``head`` up to
+        ``batch_window_ms`` while compatible peers arrive — same shape
+        bucket, a DISTINCT tile (sessions are single-threaded), forward
+        kind (smoothed never mixes), not a crash replay.  Flushes
+        immediately when the window is off, the head is ineligible, or
+        a drain/stop is in progress (no request waits out the window
+        during SIGTERM drain or ``--exit-when-idle``)."""
+        group = [head]
+        if (
+            self._batch_window_s <= 0.0 or self._max_batch <= 1
+            or head.smoothed or head.replayed
+            or self._draining.is_set() or self._stopped.is_set()
+        ):
+            return group
+        key = batching.session_bucket_key(self.sessions[head.tile])
+        if key is None:
+            return group
+        tiles = {head.tile}
+        deadline = time.perf_counter() + self._batch_window_s
+        with self._cond:
+            while len(group) < self._max_batch:
+                for peer in list(self._queue):
+                    if (
+                        peer.smoothed or peer.replayed
+                        or peer.tile in tiles
+                    ):
+                        continue
+                    session = self.sessions.get(peer.tile)
+                    if session is None:
+                        continue
+                    if batching.session_bucket_key(session) != key:
+                        continue
+                    self._queue.remove(peer)
+                    group.append(peer)
+                    tiles.add(peer.tile)
+                    if len(group) >= self._max_batch:
+                        break
+                if (
+                    len(group) >= self._max_batch
+                    or self._draining.is_set()
+                    or self._stopped.is_set()
+                ):
+                    break
+                wait = deadline - time.perf_counter()
+                if wait <= 0:
+                    break
+                self._cond.wait(timeout=wait)
+            self._set_depth_locked()
+        return group
+
+    def _process_batch(self, group: list) -> None:
+        """Serve one coalesced admission group: every member runs its
+        FULL request pipeline concurrently (deadline, cache, solve,
+        respond — one thread per member), with the engine dispatches
+        meeting in the batch executor's rendezvous.  A member that
+        errors, cancels or serves from cache simply leaves the
+        rendezvous; its peers batch without it."""
+        batch_id = f"batch-{group[0].request_id}"
+        size = len(group)
+        self._m["batches"].inc()
+        self._m["batch_requests"].inc(size)
+        get_registry().emit(
+            "serve_batch_admitted", batch_id=batch_id, size=size,
+            tiles=[r.tile for r in group],
+        )
+        for req in group:
+            req.batch_id = batch_id
+            req.batch_size = size
+        members = self._executor.open(size)
+        ctx = tracing.current_context()
+        threads = []
+        for req, member in list(zip(group, members))[1:]:
+            t = threading.Thread(
+                target=self._process_member,
+                args=(req, member, ctx),
+                name=f"serve-batch-{req.request_id}", daemon=True,
+            )
+            t.start()
+            threads.append(t)
+        self._process_member(group[0], members[0], ctx)
+        for t in threads:
+            t.join()
+
+    def _process_member(self, req: ServeRequest, member, ctx) -> None:
+        # PR 3 thread-tracing convention: contextvars don't cross
+        # thread creation — re-install the worker's context first.
+        tracing.set_context(ctx)
+        try:
+            with tracing.push(request_id=req.request_id):
+                self._process_traced(req, member=member)
+        finally:
+            member.close()
 
     def _process(self, req: ServeRequest) -> None:
         # Request-scoped trace context: every span from here down —
@@ -392,15 +522,19 @@ class AssimilationService:
     def _trace_block(self, req: ServeRequest, phases: Dict) -> dict:
         """The response's ``trace`` stamp (finalised in _respond: the
         dump phase and e2e close when the answer is published)."""
-        return {
+        out = {
             "request_id": req.request_id,
             "phases": {k: round(v, 3) for k, v in phases.items()},
             "admitted_ts": req.admitted_ts,
             "replayed": req.replayed,
             "_anchor_perf": time.perf_counter(),
         }
+        if req.batch_id is not None:
+            out["batch_id"] = req.batch_id
+            out["batch_size"] = req.batch_size
+        return out
 
-    def _process_traced(self, req: ServeRequest) -> None:
+    def _process_traced(self, req: ServeRequest, member=None) -> None:
         reg = get_registry()
         # The request KIND is part of the response identity: a smoothed
         # (reanalysis) answer and the forward analysis for the same
@@ -413,6 +547,10 @@ class AssimilationService:
             if req.deadline is not None:
                 req.deadline.check(f"request {req.request_id}")
         except DeadlineExceeded as exc:
+            if member is not None:
+                # Leave the rendezvous BEFORE the respond write: batch
+                # peers must never wait on a cancelled member's I/O.
+                member.close()
             self._m["cancelled"].inc()
             reg.emit(
                 "request_cancelled", request_id=req.request_id,
@@ -429,8 +567,13 @@ class AssimilationService:
         # chain grows with every forward serve — caching one would pin a
         # stale smoothed state past the next checkpoint.  Forward
         # answers are append-only facts; only those are cacheable.
-        cached = None if req.smoothed else self._cache.get(key)
+        with self._cache_lock:
+            cached = None if req.smoothed else self._cache.get(key)
         if cached is not None:
+            if member is not None:
+                # A cache-hit member leaves immediately; its batch
+                # peers rendezvous without it (mixed hit/miss groups).
+                member.close()
             self._m["cache_hits"].inc()
             body = dict(cached)
             body.pop("trace", None)
@@ -443,12 +586,24 @@ class AssimilationService:
                 "serve.solve", request=req.request_id, tile=req.tile,
             )
             session = self.sessions[req.tile]
-            # Forward requests keep the bare call so any duck-typed
-            # session serves them; only the reanalysis kind requires a
-            # smoother-aware session.
-            if req.smoothed:
-                return session.serve(req.date, smoothed=True)
-            return session.serve(req.date)
+            # All solve dispatch goes through the sanctioned executor
+            # module (kafkalint rule 22).  Only a batch member's FIRST
+            # attempt is coalesced: whatever its outcome, the member
+            # leaves the rendezvous right there (inside the finally —
+            # peers never wait on this request's retry backoff or
+            # response write), and any retry runs solo.
+            if member is not None and not member.used:
+                member.used = True
+                try:
+                    return batching.solve_session(
+                        session, req.date, smoothed=req.smoothed,
+                        dispatcher=member.dispatcher(),
+                    )
+                finally:
+                    member.close()
+            return batching.solve_session(
+                session, req.date, smoothed=req.smoothed,
+            )
 
         try:
             if req.replayed:
@@ -475,11 +630,18 @@ class AssimilationService:
             return
         body = dict(body)
         phases.update(body.pop("trace_phases", {}))
+        if member is not None and member.batch_spans:
+            # Device time this request spent inside coalesced launches
+            # (amortised across the members riding each launch).
+            phases["serve_batch_ms"] = round(sum(
+                (t1 - t0) * 1e3 for t0, t1 in member.batch_spans
+            ), 3)
         if not req.smoothed:
-            self._cache[key] = body
-            self._cache.move_to_end(key)
-            while len(self._cache) > self._cache_size:
-                self._cache.popitem(last=False)
+            with self._cache_lock:
+                self._cache[key] = body
+                self._cache.move_to_end(key)
+                while len(self._cache) > self._cache_size:
+                    self._cache.popitem(last=False)
         self._finish_ok(req, body, phases)
 
     def _finish(self, req: ServeRequest, body: dict,
@@ -528,6 +690,8 @@ class AssimilationService:
             replayed=req.replayed or None,
             solver_health=body.get("solver_health"),
             quality=body.get("quality"),
+            batch_id=req.batch_id,
+            batch_size=req.batch_size,
         ))
 
     def requestz(self, n: int = 32) -> dict:

@@ -110,17 +110,45 @@ class TileSession:
         #: diagnostics access; the durable state is the checkpoint set.
         self.last_state: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self.serves = 0
+        self._bucket = None
+        self._bucket_built = False
 
     # -- the serve path -------------------------------------------------
 
+    def serve_bucket(self):
+        """The tile's serve shape bucket (``serve.batch.ShapeBucket``) —
+        the coarse compatibility fingerprint the admission micro-window
+        groups on, plus the representative pieces AOT lowering needs.
+        Built once from a throwaway filter; ``None`` when the tile's
+        configuration cannot coalesce (fused scans, band-sequential
+        loops, filters the probe cannot build)."""
+        if not self._bucket_built:
+            self._bucket_built = True
+            from .batch import probe_bucket
+
+            try:
+                self._bucket = probe_bucket(self)
+            except Exception:
+                LOG.warning(
+                    "tile %s: serve-bucket probe failed; the tile will "
+                    "serve unbatched", self.name, exc_info=True,
+                )
+                self._bucket = None
+        return self._bucket
+
     def serve(self, date: datetime.datetime,
-              smoothed: bool = False) -> dict:
+              smoothed: bool = False, dispatcher=None) -> dict:
         """Answer one observation-date request; returns the response
         body (status/served_from/summary fields, JSON-serialisable).
         ``smoothed=True`` answers with the RTS reanalysis from the
-        checkpoint chain instead of running the forward filter."""
+        checkpoint chain instead of running the forward filter.
+        ``dispatcher`` (coalesced serving) replaces the engine's per-date
+        solve dispatch — same signature and bit-identical results as
+        ``assimilate_date_jit`` from this session's point of view."""
         t0 = time.perf_counter()
         kf, x0, p_inv0, output = self.spec.make_filter()
+        if dispatcher is not None:
+            kf.date_dispatcher = dispatcher
         # Tile-scoped trace/quality context: the quality ledger keys its
         # sentinel streams by chunk_id, so each tile keeps its own
         # per-band chi^2 series (the serving analogue of a chunk).

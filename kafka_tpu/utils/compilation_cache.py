@@ -24,8 +24,15 @@ _DEFAULT_DIR = os.path.join(
 )
 
 
-def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
+def enable_compilation_cache(cache_dir: str | None = None,
+                             min_compile_time_s: float = 0.5) -> str | None:
     """Point JAX at a persistent on-disk compilation cache.
+
+    ``min_compile_time_s`` lowers the persistence threshold for callers
+    whose compiles are fast but still worth caching — the serving
+    daemon's AOT bucket warm-up wants ZERO re-compiles on restart, so it
+    passes 0 and eats the (harmless on matching hardware) XLA:CPU AOT
+    load-time warnings.
 
     Returns the cache directory, or ``None`` when disabled (env opt-out
     or a JAX without the config knobs)."""
@@ -54,7 +61,8 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
         # eager ops, ~10 s for the solver programs) all cache; sub-100 ms
         # host-CPU compiles don't — XLA:CPU AOT entries are the ones that
         # warn about machine-feature mismatches at load time.
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_s))
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except (AttributeError, ValueError, OSError) as e:
         LOG.info("compilation cache unavailable: %s", e)

@@ -38,6 +38,9 @@ EXPECTED_KEYS = [
     "serve_slo_alerts_total", "serve_slo_budget_remaining",
     "probe_device_ms", "probe_host_ms", "probe_retried",
     "unhealthy_reasons", "probe_host_after_ms", "unhealthy",
+    "serve_sweep", "serve_batched_px_s", "serve_batch_mean_size",
+    "serve_queue_wait_p99_ms", "serve_unbatched_p99_ms",
+    "serve_unbatched_queue_wait_p99_ms",
     "telemetry", "solver_health", "quality", "perf", "slo",
     "device_profile", "program_contracts",
 ]
@@ -78,6 +81,26 @@ FLEET_ROWS = {
 }
 
 
+#: a tools/loadgen.bench_concurrency_sweep dict, as the coalesced-serving
+#: bench emits it (ISSUE 20).
+SWEEP_ROWS = {
+    "serve_sweep": [
+        {"concurrency": 1, "serve_p99_ms": 40.0,
+         "serve_queue_wait_p99_ms": 1.0, "serve_batch_mean_size": 1.0,
+         "serve_batch_coalesced_total": 0, "serve_px_s": 6.0e3},
+        {"concurrency": 32, "serve_p99_ms": 210.0,
+         "serve_queue_wait_p99_ms": 160.0, "serve_batch_mean_size": 7.5,
+         "serve_batch_coalesced_total": 30, "serve_px_s": 1.1e4},
+    ],
+    "serve_sweep_concurrencies": [1, 32],
+    "serve_batched_px_s": 1.1e4,
+    "serve_batch_mean_size": 7.5,
+    "serve_queue_wait_p99_ms": 160.0,
+    "serve_unbatched_p99_ms": 260.0,
+    "serve_unbatched_queue_wait_p99_ms": 240.0,
+}
+
+
 #: a bench.bench_smoother_rows dict, as the reanalysis bench emits it.
 SMOOTHER_ROWS = {
     "device_smoother_ms": 12.5,
@@ -86,7 +109,8 @@ SMOOTHER_ROWS = {
 
 
 def _assemble(reg, host_after_ms=0.3, serve=SERVE_ROWS,
-              fleet=FLEET_ROWS, smoother=SMOOTHER_ROWS):
+              fleet=FLEET_ROWS, smoother=SMOOTHER_ROWS,
+              sweep=SWEEP_ROWS):
     health = bench.probe_health(retry_wait_s=0.0, registry=reg)
     return health, bench.assemble_result(
         health,
@@ -99,6 +123,7 @@ def _assemble(reg, host_after_ms=0.3, serve=SERVE_ROWS,
         serve=serve,
         fleet=fleet,
         smoother=smoother,
+        sweep=sweep,
         host_after_ms=host_after_ms,
         registry=reg,
     )
@@ -362,6 +387,29 @@ class TestBenchArtifactSchema:
         assert result["device_smoother_px_s"] is None
         assert result["serve_smoothed_p50_ms"] is None
         assert result["serve_smoothed_p99_ms"] is None
+
+    def test_sweep_rows_flow_through(self):
+        """The coalesced-serving concurrency-sweep rows (tools/loadgen
+        bench_concurrency_sweep) land verbatim; a run without a sweep
+        degrades them to null (serve_batched_px_s disappearance then
+        gates in bench_compare like the other throughput rows)."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            _, result = _assemble(reg)
+        assert result["serve_batched_px_s"] == 1.1e4
+        assert result["serve_batch_mean_size"] == 7.5
+        assert result["serve_queue_wait_p99_ms"] == 160.0
+        assert result["serve_unbatched_p99_ms"] == 260.0
+        assert result["serve_unbatched_queue_wait_p99_ms"] == 240.0
+        assert [r["concurrency"] for r in result["serve_sweep"]] == \
+            [1, 32]
+        with telemetry.use(MetricsRegistry()) as reg:
+            _, result = _assemble(reg, sweep=None)
+        assert result["serve_sweep"] is None
+        assert result["serve_batched_px_s"] is None
+        assert result["serve_batch_mean_size"] is None
+        assert result["serve_queue_wait_p99_ms"] is None
+        assert result["serve_unbatched_p99_ms"] is None
+        assert result["serve_unbatched_queue_wait_p99_ms"] is None
 
     def test_live_telemetry_flows_through(self):
         """The mid-run /metrics scrape series (tools/loadgen) lands

@@ -39,7 +39,7 @@ ALL_RULES = {
     "ad-hoc-timing", "nondeterministic-placement",
     "request-id-origin", "magic-slo-threshold",
     "forward-state-mutation-in-smoother", "raw-device-introspection",
-    "unregistered-device-program",
+    "unregistered-device-program", "unbatched-serve-dispatch",
 }
 
 
@@ -258,7 +258,7 @@ def test_json_output_schema(capsys):
     payload = json.loads(capsys.readouterr().out)
     assert payload["version"] == 1
     assert payload["root"] == os.path.abspath(FIXTURES)
-    assert payload["files_scanned"] == 22
+    assert payload["files_scanned"] == 23
     assert set(payload["rules"]) >= ALL_RULES
     assert isinstance(payload["findings"], list) and payload["findings"]
     for f in payload["findings"]:

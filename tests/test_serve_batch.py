@@ -1,0 +1,551 @@
+"""Coalesced serving (ISSUE 20): the admission micro-window, batched
+device launches, and AOT-compiled shape buckets.
+
+Acceptance pins:
+
+- partition invariance: ANY partition of K compatible requests into
+  admission groups yields byte-identical payloads (x_sha256,
+  solver_health, quality) to serving them one at a time — including
+  mixed cache-hit/miss groups and a mid-batch poison member erroring
+  ALONE while its peers' answers stay bit-identical;
+- every served_from path a coalesced member can take (cold, warm,
+  warm_noop, cache) is bit-identical to the solo path;
+- a partially-filled micro-window flushes IMMEDIATELY on drain — a
+  SIGTERM never waits out the window (drain-latency regression);
+- AOT restart contract: a second daemon start over a warm
+  --compile-cache-dir serves its first request with zero
+  kafka_compile_cache_misses_total for the declared buckets;
+- the loadgen rows: under concurrent compatible load the mean admission
+  group size exceeds 1 and the queue_wait p99 drops vs the same load
+  with the window off.
+
+All tier-1 / CPU.
+"""
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from kafka_tpu import telemetry
+from kafka_tpu.resilience import POISON, RetryPolicy, faults
+from kafka_tpu.serve import (
+    AdmissionPolicy,
+    AssimilationService,
+    TileSession,
+    make_synthetic_tile,
+    read_response,
+    submit_request,
+    synthetic_dates,
+)
+from kafka_tpu.serve import batch as batching
+from kafka_tpu.serve.synthetic import DEFAULT_BASE_DATE
+from kafka_tpu.telemetry import MetricsRegistry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the default synthetic tile's observation calendar (see test_serve).
+DATES = synthetic_dates(DEFAULT_BASE_DATE, 16, 2)
+
+#: cold / warm_noop / warm ladder: D1 assimilates its whole grid window,
+#: so D2 (same window, different calendar date — a DISTINCT result-cache
+#: key) is a warm_noop, and D3 (next window) is a warm incremental.
+D1, D2, D3 = DATES[0], DATES[1], DATES[2]
+
+FAST2 = RetryPolicy(max_attempts=2, base_delay=0.0, jitter=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _subprocess_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop(faults.ENV_VAR, None)
+    return env
+
+
+def _sig(body):
+    """The payload identity the partition property quantifies over."""
+    return (
+        body.get("x_sha256"),
+        body.get("solver_health"),
+        body.get("quality"),
+    )
+
+
+def _batch_stamp(body):
+    trace = body.get("trace") or {}
+    return trace.get("batch_id"), trace.get("batch_size")
+
+
+class _Bucket:
+    def __init__(self, key):
+        self.key = key
+
+
+class BucketStubSession:
+    """Duck-typed session WITH a shape bucket: exercises the admission
+    micro-window deterministically.  No JAX — a member that never
+    dispatches simply leaves the rendezvous, so the stub's sleep models
+    the per-tile solve the window lets run concurrently."""
+
+    def __init__(self, name, key="bucket0", sleep_s=0.0):
+        self.name = name
+        self._key = key
+        self.sleep_s = sleep_s
+        self.serves = 0
+
+    def serve_bucket(self):
+        return None if self._key is None else _Bucket((self._key,))
+
+    def serve(self, date, smoothed=False, dispatcher=None):
+        self.serves += 1
+        if self.sleep_s:
+            time.sleep(self.sleep_s)
+        return {
+            "status": "ok", "x_sha256": f"stub-{self.name}",
+            "date": date.isoformat(), "served_from": "cold",
+        }
+
+
+def stub_batch_service(tmp_path, names, window_ms=250.0, max_batch=8,
+                       key="bucket0", sleep_s=0.0, keys=None):
+    sessions = {
+        n: BucketStubSession(
+            n, key=(keys[i] if keys is not None else key),
+            sleep_s=sleep_s,
+        )
+        for i, n in enumerate(names)
+    }
+    svc = AssimilationService(
+        sessions, str(tmp_path),
+        policy=AdmissionPolicy(max_queue_depth=64),
+        retry_policy=FAST2,
+        batch_window_ms=window_ms, max_batch=max_batch,
+    )
+    return svc, sessions
+
+
+def _submit_group(svc, reqs):
+    for tile, date, rid in reqs:
+        svc.submit({
+            "tile": tile, "date": date.isoformat(), "request_id": rid,
+        })
+    return {
+        rid: svc.result(rid, timeout_s=120) for _, _, rid in reqs
+    }
+
+
+# ---------------------------------------------------------------------------
+# micro-window mechanics (stub sessions: deterministic, no JAX)
+# ---------------------------------------------------------------------------
+
+class TestMicroWindow:
+    def test_window_coalesces_compatible_tiles(self, tmp_path):
+        """Four compatible single-date requests land in ONE admission
+        group: shared batch_id, batch_size 4 on every response trace,
+        and the group counters move once."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            names = [f"t{i}" for i in range(4)]
+            svc, sessions = stub_batch_service(
+                tmp_path, names, window_ms=2000.0, max_batch=4,
+            )
+            svc.start()
+            try:
+                got = _submit_group(svc, [
+                    (n, D1, f"r-{n}") for n in names
+                ])
+                stamps = {r: _batch_stamp(b) for r, b in got.items()}
+                assert all(b["status"] == "ok" for b in got.values())
+                ids = {s[0] for s in stamps.values()}
+                assert len(ids) == 1 and None not in ids
+                assert all(s[1] == 4 for s in stamps.values())
+                assert reg.value("kafka_serve_batches_total") == 1
+                assert reg.value(
+                    "kafka_serve_batch_requests_total") == 4
+                assert all(s.serves == 1 for s in sessions.values())
+            finally:
+                svc.close()
+
+    def test_same_tile_and_smoothed_never_mix(self, tmp_path):
+        """A same-tile peer and a smoothed request are never coalesced:
+        tile sessions are single-threaded, and reanalysis is a different
+        product (different launch structure) from the forward serve."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            svc, sessions = stub_batch_service(
+                tmp_path, ["t0", "t1"], window_ms=150.0, max_batch=8,
+            )
+            svc.start()
+            try:
+                got = _submit_group(svc, [
+                    ("t0", D1, "a"), ("t0", D2, "a2"), ("t1", D1, "b"),
+                ])
+                # t0+t1 coalesce; the second t0 request serves alone.
+                assert _batch_stamp(got["a"])[1] == 2
+                assert _batch_stamp(got["b"])[1] == 2
+                assert _batch_stamp(got["a2"]) == (None, None)
+                # A smoothed head flushes immediately, never batched.
+                svc.submit({"tile": "t0", "date": D3.isoformat(),
+                            "request_id": "sm", "smoothed": True})
+                sm = svc.result("sm", timeout_s=30)
+                assert sm["status"] == "ok"
+                assert _batch_stamp(sm) == (None, None)
+                assert reg.value("kafka_serve_batches_total") == 1
+            finally:
+                svc.close()
+
+    def test_incompatible_buckets_do_not_mix(self, tmp_path):
+        """Different shape-bucket keys (and bucketless duck-typed
+        sessions) never share an admission group."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            svc, _ = stub_batch_service(
+                tmp_path, ["t0", "t1", "t2"], window_ms=100.0,
+                keys=["ka", "kb", None],
+            )
+            svc.start()
+            try:
+                got = _submit_group(svc, [
+                    ("t0", D1, "a"), ("t1", D1, "b"), ("t2", D1, "c"),
+                ])
+                assert all(b["status"] == "ok" for b in got.values())
+                assert all(
+                    _batch_stamp(b) == (None, None)
+                    for b in got.values()
+                )
+                assert reg.value("kafka_serve_batches_total") is None
+            finally:
+                svc.close()
+
+    def test_drain_flushes_partial_window_immediately(self, tmp_path):
+        """The drain-latency regression (satellite): a SIGTERM drain
+        must not wait out a partially-filled 10 s window — the open
+        window flushes the moment draining starts."""
+        with telemetry.use(MetricsRegistry()):
+            svc, _ = stub_batch_service(
+                tmp_path, ["t0", "t1"], window_ms=10_000.0,
+            )
+            svc.start()
+            try:
+                svc.submit({"tile": "t0", "date": D1.isoformat(),
+                            "request_id": "r1"})
+                # Let the worker dequeue r1 and open the window.
+                deadline = time.monotonic() + 5
+                while svc.pending() and time.monotonic() < deadline:
+                    time.sleep(0.005)
+                time.sleep(0.05)
+                t0 = time.monotonic()
+                svc.stop_admitting()
+                assert svc.drain(timeout_s=30)
+                got = svc.result("r1", timeout_s=1)
+                waited = time.monotonic() - t0
+                assert got is not None and got["status"] == "ok"
+                assert waited < 2.0, (
+                    f"drain waited {waited:.1f}s on an open 10s window"
+                )
+            finally:
+                svc.close()
+
+    def test_replayed_requests_flush_immediately(self, tmp_path):
+        """Journal replay is recovery, not interactive traffic: a
+        replayed request never waits out the window (nor batches)."""
+        with telemetry.use(MetricsRegistry()) as reg:
+            svc, _ = stub_batch_service(tmp_path, ["t0", "t1"])
+            svc.start()
+            try:
+                faults.script("serve.respond", "1", POISON)
+                svc.submit({"tile": "t0", "date": D1.isoformat(),
+                            "request_id": "r1"})
+                deadline = time.monotonic() + 30
+                while reg.value("kafka_serve_respond_errors_total") \
+                        is None and time.monotonic() < deadline:
+                    time.sleep(0.01)
+            finally:
+                svc.close()
+            faults.reset()
+            # "Restart" with a LONG window: replay answers fast anyway.
+            svc2, _ = stub_batch_service(
+                tmp_path, ["t0", "t1"], window_ms=10_000.0,
+            )
+            t0 = time.monotonic()
+            svc2.start()
+            try:
+                r1 = svc2.result("r1", timeout_s=5)
+                waited = time.monotonic() - t0
+                assert r1 is not None and r1["status"] == "ok"
+                assert _batch_stamp(r1) == (None, None)
+                assert waited < 2.0
+                assert reg.value("kafka_serve_replayed_total") == 1
+            finally:
+                svc2.close()
+
+
+# ---------------------------------------------------------------------------
+# the loadgen rows: coalescing shrinks queue_wait under compatible load
+# ---------------------------------------------------------------------------
+
+class TestLoadgenBatchRows:
+    def test_batched_load_shrinks_queue_wait(self, tmp_path):
+        """Eight concurrent compatible requests against sleeping stub
+        tiles: with the window on, the group serves concurrently (mean
+        batch size 8, queue_wait collapses); with the window off (the
+        runtime toggle), the same load serializes and the queue_wait
+        p99 balloons — the row pair the sweep bench gates on."""
+        from tools.loadgen import _Target, run_load
+
+        with telemetry.use(MetricsRegistry()):
+            names = [f"t{i}" for i in range(8)]
+            svc, _ = stub_batch_service(
+                tmp_path, names, window_ms=500.0, max_batch=8,
+                sleep_s=0.08,
+            )
+            svc.start()
+            try:
+                plan = [
+                    {"tile": n, "date": D1.isoformat(),
+                     "request_id": f"bat-{n}"}
+                    for n in names
+                ]
+                batched = run_load(_Target(service=svc), plan,
+                                   concurrency=8, timeout_s=60)
+                svc.set_batch_window(0.0)
+                plan = [
+                    {"tile": n, "date": D2.isoformat(),
+                     "request_id": f"unb-{n}"}
+                    for n in names
+                ]
+                unbatched = run_load(_Target(service=svc), plan,
+                                     concurrency=8, timeout_s=60)
+            finally:
+                svc.close()
+        assert batched["serve_ok_total"] == 8
+        assert unbatched["serve_ok_total"] == 8
+        assert batched["serve_batch_mean_size"] == 8.0
+        assert batched["serve_batch_coalesced_total"] == 8
+        assert batched["serve_solved_total"] == 8
+        assert unbatched["serve_batch_mean_size"] == 1.0
+        assert unbatched["serve_batch_coalesced_total"] == 0
+        # 8 x 80 ms serialized vs one concurrent group: the window is
+        # what keeps the queue from stacking.
+        assert batched["serve_queue_wait_p99_ms"] < \
+            unbatched["serve_queue_wait_p99_ms"]
+
+
+# ---------------------------------------------------------------------------
+# partition invariance + served_from-path parity (real tiles, real solves)
+# ---------------------------------------------------------------------------
+
+def _tile(tmp_path, name, seed):
+    return TileSession(make_synthetic_tile(
+        name, str(tmp_path / f"ck_{name}_{seed}"), seed=seed,
+    ))
+
+
+def _real_service(tmp_path, tag, seeds, window_ms=1500.0, max_batch=2):
+    sessions = {
+        f"t{k}": _tile(tmp_path, f"{tag}t{k}", seed)
+        for k, seed in enumerate(seeds)
+    }
+    svc = AssimilationService(
+        sessions, str(tmp_path / f"root_{tag}"),
+        policy=AdmissionPolicy(max_queue_depth=64),
+        batch_window_ms=window_ms, max_batch=max_batch,
+    )
+    return svc, sessions
+
+
+class TestPartitionBitIdentity:
+    """The satellite property: partitions of compatible requests into
+    admission groups are payload-invariant, across every served_from
+    path a member can take."""
+
+    SEEDS = {"t0": 1, "t1": 2, "t2": 3}
+
+    def test_partitions_and_served_from_paths(self, tmp_path):
+        """One service, one ladder: {t0,t1} batched + {t2} solo at D1
+        (cold), {t0,t1} batched at D2 (warm_noop) and D3 (warm), then a
+        mixed cache-hit/miss group — every payload byte-identical to
+        the one-at-a-time baselines."""
+        base = {}
+        for t in ("t0", "t1", "t2"):
+            sess = _tile(tmp_path, f"solo{t}", self.SEEDS[t])
+            for d in (D1, D2, D3):
+                r = sess.serve(d)
+                base[(t, d)] = (_sig(r), r["served_from"])
+        assert base[("t0", D1)][1] == "cold"
+        assert base[("t0", D2)][1] == "warm_noop"
+        assert base[("t0", D3)][1] == "warm"
+
+        with telemetry.use(MetricsRegistry()):
+            svc, _ = _real_service(
+                tmp_path, "p1", [self.SEEDS[t] for t in
+                                 ("t0", "t1", "t2")],
+            )
+            svc.start()
+            try:
+                # cold, batched {t0,t1} + solo {t2}.
+                got = _submit_group(svc, [
+                    ("t0", D1, "c0"), ("t1", D1, "c1"),
+                ])
+                got.update(_submit_group(svc, [("t2", D1, "c2")]))
+                assert _batch_stamp(got["c0"])[1] == 2
+                assert _batch_stamp(got["c0"])[0] == \
+                    _batch_stamp(got["c1"])[0]
+                assert _batch_stamp(got["c2"]) == (None, None)
+                for rid, tile in (("c0", "t0"), ("c1", "t1"),
+                                  ("c2", "t2")):
+                    assert got[rid]["served_from"] == "cold"
+                    assert _sig(got[rid]) == base[(tile, D1)][0], rid
+                # warm_noop, batched: same grid window, new date.
+                got = _submit_group(svc, [
+                    ("t0", D2, "n0"), ("t1", D2, "n1"),
+                ])
+                for rid, tile in (("n0", "t0"), ("n1", "t1")):
+                    assert got[rid]["served_from"] == "warm_noop"
+                    assert _batch_stamp(got[rid])[1] == 2
+                    assert _sig(got[rid]) == base[(tile, D2)][0], rid
+                # warm incremental, batched: the next grid window.
+                got = _submit_group(svc, [
+                    ("t0", D3, "w0"), ("t1", D3, "w1"),
+                ])
+                for rid, tile in (("w0", "t0"), ("w1", "t1")):
+                    assert got[rid]["served_from"] == "warm"
+                    assert _batch_stamp(got[rid])[1] == 2
+                    assert _sig(got[rid]) == base[(tile, D3)][0], rid
+                # mixed cache-hit/miss group: t0@D1 re-requested (the
+                # result cache answers; the member leaves the
+                # rendezvous) alongside t2@D3 (a real warm solve that
+                # launches without the departed peer).
+                got = _submit_group(svc, [
+                    ("t0", D1, "m0"), ("t2", D3, "m1"),
+                ])
+                assert got["m0"]["served_from"] == "cache"
+                assert _batch_stamp(got["m0"])[1] == 2
+                assert _sig(got["m0"]) == base[("t0", D1)][0]
+                assert got["m1"]["served_from"] == "warm"
+                assert _batch_stamp(got["m1"])[1] == 2
+                assert _sig(got["m1"]) == base[("t2", D3)][0]
+            finally:
+                svc.close()
+
+    def test_alternative_partition_and_mid_batch_poison(self, tmp_path):
+        """The complementary partition {t0,t2} + {t1} matches the same
+        baselines; then a poison member errors ALONE — its batch peer's
+        answer stays bit-identical and the service survives."""
+        base = {}
+        for t in ("t0", "t1", "t2"):
+            sess = _tile(tmp_path, f"solo{t}", self.SEEDS[t])
+            for d in (D1, D3):
+                base[(t, d)] = _sig(sess.serve(d))
+
+        with telemetry.use(MetricsRegistry()) as reg:
+            svc, _ = _real_service(
+                tmp_path, "p2", [self.SEEDS[t] for t in
+                                 ("t0", "t1", "t2")],
+            )
+            svc.start()
+            try:
+                got = _submit_group(svc, [
+                    ("t0", D1, "c0"), ("t2", D1, "c2"),
+                ])
+                got.update(_submit_group(svc, [("t1", D1, "c1")]))
+                assert _batch_stamp(got["c0"])[1] == 2
+                assert _batch_stamp(got["c2"])[1] == 2
+                assert _batch_stamp(got["c1"]) == (None, None)
+                for rid, tile in (("c0", "t0"), ("c1", "t1"),
+                                  ("c2", "t2")):
+                    assert _sig(got[rid]) == base[(tile, D1)], rid
+                # Poison exactly one member of the next group: the
+                # fault scripts by call number, so WHICH member dies is
+                # scheduling-dependent — the contract is that exactly
+                # one errors and the survivor stays bit-identical.
+                faults.script("serve.solve", "1", POISON)
+                got = _submit_group(svc, [
+                    ("t0", D3, "x0"), ("t2", D3, "x2"),
+                ])
+                by_status = {b["status"] for b in got.values()}
+                assert by_status == {"ok", "error"}
+                for rid, tile in (("x0", "t0"), ("x2", "t2")):
+                    assert _batch_stamp(got[rid])[1] == 2
+                    if got[rid]["status"] == "ok":
+                        assert got[rid]["served_from"] == "warm"
+                        assert _sig(got[rid]) == base[(tile, D3)], rid
+                assert reg.value("kafka_serve_errors_total") == 1
+                faults.reset()
+                # The daemon survives: the next request is fine.
+                got = _submit_group(svc, [("t1", D3, "after")])
+                assert got["after"]["status"] == "ok"
+                assert _sig(got["after"]) == base[("t1", D3)]
+            finally:
+                svc.close()
+
+
+# ---------------------------------------------------------------------------
+# AOT restart contract (two daemon processes over one compile cache)
+# ---------------------------------------------------------------------------
+
+def _sum_counter(metrics, name):
+    series = (metrics.get(name) or {}).get("series") or []
+    return sum(s.get("value") or 0 for s in series)
+
+
+class TestAOTWarmRestart:
+    def test_second_start_serves_first_request_with_zero_misses(
+            self, tmp_path):
+        """The AOT acceptance pin: daemon start #1 AOT-compiles the
+        declared buckets into --compile-cache-dir and serves a cold
+        request; start #2 over a FRESH serve root + checkpoint chain
+        (same shapes, warm cache) re-solves the same date with zero
+        kafka_compile_cache_misses_total — every lowering is a disk
+        hit, and the answers agree bit-for-bit."""
+        cache = tmp_path / "xla_cache"
+        date = synthetic_dates(DEFAULT_BASE_DATE, 8, 2)[0]
+
+        def run(tag):
+            root = tmp_path / f"root_{tag}"
+            tele = tmp_path / f"tele_{tag}"
+            root.mkdir()
+            submit_request(str(root), {
+                "tile": "tile0", "date": date.isoformat(),
+                "request_id": f"req-{tag}",
+            })
+            proc = subprocess.run(
+                [sys.executable, "-m", "kafka_tpu.cli.kafka_serve",
+                 "--root", str(root), "--tiles", "1",
+                 "--operator", "identity", "--ny", "8", "--nx", "8",
+                 "--days", "8", "--step", "4", "--obs-every", "2",
+                 "--compile-cache-dir", str(cache),
+                 "--telemetry-dir", str(tele),
+                 "--poll-interval-s", "0.02",
+                 "--exit-when-idle", "--idle-grace-s", "0.3"],
+                env=_subprocess_env(), cwd=REPO_ROOT,
+                capture_output=True, text=True, timeout=600,
+            )
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            summary = json.loads(proc.stdout.strip().splitlines()[-1])
+            assert summary["errors"] == 0
+            got = read_response(str(root), f"req-{tag}")
+            assert got is not None and got["status"] == "ok"
+            with open(tele / "metrics.json") as f:
+                metrics = json.load(f)
+            return got, metrics
+
+        got1, m1 = run("one")
+        assert got1["served_from"] == "cold"
+        # Start #1 pays the real compiles (cold disk cache).
+        assert _sum_counter(m1, "kafka_compile_cache_misses_total") > 0
+
+        got2, m2 = run("two")
+        assert got2["served_from"] == "cold"
+        assert got2["x_sha256"] == got1["x_sha256"]
+        assert _sum_counter(m2, "kafka_compile_cache_misses_total") == 0
+        assert _sum_counter(m2, "kafka_compile_cache_hits_total") > 0

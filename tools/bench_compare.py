@@ -63,9 +63,11 @@ GATED_ROW_PATTERNS = ("device_*_ms", "serve_p50_ms", "serve_p99_ms",
                       "serve_fleet_p50_ms", "serve_fleet_p99_ms",
                       "serve_smoothed_p99_ms")
 #: gated throughput rows (LARGER is better): the reanalysis sweep's
-#: pixel-windows/s.  Same disappearance rule; the regression direction
-#: is inverted.
-GATED_THROUGHPUT_PATTERNS = ("device_smoother_px_s",)
+#: pixel-windows/s and the coalesced-serving launch throughput at the
+#: sweep's top concurrency (tools/loadgen.bench_concurrency_sweep).
+#: Same disappearance rule; the regression direction is inverted.
+GATED_THROUGHPUT_PATTERNS = ("device_smoother_px_s",
+                             "serve_batched_px_s")
 DEVICE_ROW_PATTERN = GATED_ROW_PATTERNS[0]  # back-compat alias
 
 

@@ -224,9 +224,10 @@ def make_rules(rule_names: Optional[Sequence[str]] = None) -> List[Rule]:
     # Import for the registration side effect; late so core stays
     # importable on its own (the shim path).
     from . import (  # noqa: F401
-        rules_devprof, rules_jax, rules_perf, rules_placement,
-        rules_programs, rules_publisher, rules_quality, rules_request,
-        rules_runtime, rules_slo, rules_smoother, rules_telemetry,
+        rules_batch, rules_devprof, rules_jax, rules_perf,
+        rules_placement, rules_programs, rules_publisher,
+        rules_quality, rules_request, rules_runtime, rules_slo,
+        rules_smoother, rules_telemetry,
     )
 
     names = sorted(REGISTRY) if rule_names is None else list(rule_names)

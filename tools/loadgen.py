@@ -237,7 +237,8 @@ def run_load(
                         backoff_total[0] += backoffs
                         results.append(
                             ("rejected", rejected.get("reason"),
-                             0.0, None, None, is_smoothed)
+                             0.0, None, None, is_smoothed, None, None,
+                             None)
                         )
                     break
                 wall_ms = (time.perf_counter() - t0) * 1e3
@@ -254,11 +255,22 @@ def run_load(
                 trace = (got or {}).get("trace") or {}
                 server_ms = trace.get("e2e_ms")
                 covered = request_log.is_covered(trace)
+                # Coalesced-serving stamps (BASELINE.md "Coalesced
+                # serving"): batch_size rides the response trace when
+                # the request was admitted into a micro-batch;
+                # queue_wait_ms is the phase the batching exists to
+                # shrink under load.
+                batch_size = trace.get("batch_size")
+                queue_wait = (trace.get("phases") or {}).get(
+                    "queue_wait_ms"
+                )
+                served_from = (got or {}).get("served_from")
                 with lock:
                     backoff_total[0] += backoffs
                     results.append(
                         (status, None, wall_ms, covered, server_ms,
-                         is_smoothed)
+                         is_smoothed, batch_size, queue_wait,
+                         served_from)
                     )
                     for key, v in health.items():
                         health_totals[key] = \
@@ -281,27 +293,47 @@ def run_load(
     # Forward and reanalysis latencies are DIFFERENT products under the
     # same roof: serve_p50/p99 keep meaning "forward analysis latency"
     # even when --smoothed mixes reanalysis reads into the load.
-    ok_lat = [w for s, _, w, _, _, sm in results
+    ok_lat = [w for s, _, w, _, _, sm, _, _, _ in results
               if s == "ok" and not sm]
-    smoothed_lat = [w for s, _, w, _, _, sm in results
+    smoothed_lat = [w for s, _, w, _, _, sm, _, _, _ in results
                     if s == "ok" and sm]
     p50, p99 = _percentiles(ok_lat)
     smoothed_p50, smoothed_p99 = _percentiles(smoothed_lat)
-    count = lambda s: sum(1 for st, _, _, _, _, _ in results if st == s)
+    count = lambda s: sum(
+        1 for st, _, _, _, _, _, _, _, _ in results if st == s
+    )
     n_ok = count("ok")
     # Tracing-coverage rows (ISSUE 14): the fraction of OK requests
     # whose named spans explain their server-side wall time, and the
     # slowest single request — the exemplar tools/trace_report.py
     # breaks down.
-    covs = [c for s, _, _, c, _, _ in results if s == "ok" and
+    covs = [c for s, _, _, c, _, _, _, _, _ in results if s == "ok" and
             c is not None]
     trace_coverage = (
         round(sum(1 for c in covs if c) / len(covs), 4)
         if covs else None
     )
     slowest = [sm if sm is not None else w
-               for s, _, w, _, sm, _ in results if s == "ok"]
+               for s, _, w, _, sm, _, _, _, _ in results if s == "ok"]
     slowest_ms = round(max(slowest), 3) if slowest else None
+    # Coalesced-serving rows over OK forward requests: the mean
+    # admission-group size (1 for requests served alone — the mean is
+    # > 1 exactly when the micro-window coalesces under this load) and
+    # the queue_wait p99 the batching exists to shrink.
+    sizes = [bs or 1 for s, _, _, _, _, sm, bs, _, _ in results
+             if s == "ok" and not sm]
+    batch_mean = (
+        round(sum(sizes) / len(sizes), 3) if sizes else None
+    )
+    coalesced = sum(1 for v in sizes if v >= 2)
+    waits = [qw for s, _, _, _, _, sm, _, qw, _ in results
+             if s == "ok" and not sm and qw is not None]
+    _, queue_wait_p99 = _percentiles(waits)
+    # Requests that paid a device solve (cold chain build or warm
+    # incremental) — the numerator of a solve-throughput rate;
+    # warm_noop and cache reads move no pixels.
+    solved = sum(1 for s, _, _, _, _, _, _, _, sf in results
+                 if s == "ok" and sf in ("cold", "warm"))
     return {
         "serve_p50_ms": p50,
         "serve_p99_ms": p99,
@@ -324,6 +356,11 @@ def run_load(
         # informationally by tools/bench_compare.py.
         "serve_trace_coverage": trace_coverage,
         "serve_slowest_ms": slowest_ms,
+        # Coalesced-serving rows (BASELINE.md "Coalesced serving").
+        "serve_batch_mean_size": batch_mean,
+        "serve_batch_coalesced_total": coalesced,
+        "serve_queue_wait_p99_ms": queue_wait_p99,
+        "serve_solved_total": solved,
         # Result QUALITY rows, summed over answered requests from the
         # per-response solver_health blocks: latency numbers alone would
         # hide a service answering fast with quarantined pixels.
@@ -451,6 +488,172 @@ def bench_serve(
         service.close()
 
 
+def bench_concurrency_sweep(
+    tmpdir: str,
+    concurrencies=(1, 8, 32),
+    tiles: int = 8,
+    batch_window_ms: float = 25.0,
+    max_batch: int = 8,
+) -> dict:
+    """Coalesced-serving sweep (the ``bench.py`` embed, BASELINE.md
+    "Coalesced serving"): ONE in-process service over ``tiles``
+    same-bucket synthetic tiles with the admission micro-window on,
+    driven at each concurrency level against a FRESH observation date
+    (so every level pays real solves, not cache hits), then once more
+    at the top level with the window off — the unbatched baseline from
+    the very same warm sessions.
+
+    Emits per-level rows (``serve_sweep``) plus the headline rows
+    ``serve_batched_px_s`` (device launch throughput at the top
+    concurrency, gated by tools/bench_compare.py),
+    ``serve_batch_mean_size`` and the batched-vs-unbatched
+    ``serve_queue_wait_p99_ms`` pair."""
+    import os
+
+    from kafka_tpu.serve import (
+        AdmissionPolicy, AssimilationService, TileSession,
+        make_synthetic_tile, synthetic_dates,
+    )
+    from kafka_tpu.serve.synthetic import DEFAULT_BASE_DATE
+
+    # The AOT warm-up below only helps the live dispatch through the
+    # persistent compilation cache (lower().compile() does not populate
+    # the in-process jit memo): point it at this run's scratch dir,
+    # with the min-compile-time floor at 0 so even fast CPU compiles
+    # persist — exactly what kafka-serve does at daemon start.
+    from kafka_tpu.utils.compilation_cache import enable_compilation_cache
+
+    enable_compilation_cache(
+        cache_dir=os.path.join(tmpdir, ".xla_cache"),
+        min_compile_time_s=0.0,
+    )
+    levels = [max(1, int(c)) for c in concurrencies]
+    # One fresh GRID WINDOW per level + warm-up + the unbatched
+    # baseline: consecutive observation dates can share a grid window
+    # (step_days=4, obs_every=2 packs two obs per window), and serving
+    # any date in a window assimilates the whole window — a level
+    # whose date the previous level already covered would measure
+    # warm_noop reads, not solves.  Stride past the window.
+    stride = 2  # obs dates per grid window at the synthetic defaults
+    n_dates_needed = stride * (len(levels) + 1) + 1
+    days = 2 * (n_dates_needed + 1)
+    sessions = {}
+    for i in range(max(2, tiles)):
+        name = f"tile{i}"
+        sessions[name] = TileSession(make_synthetic_tile(
+            name, ckpt_dir=os.path.join(tmpdir, f"ckpt_{name}"),
+            days=days, seed=i,
+        ))
+    dates = synthetic_dates(DEFAULT_BASE_DATE, days=days, obs_every=2)
+    names = sorted(sessions)
+    service = AssimilationService(
+        sessions, tmpdir,
+        policy=AdmissionPolicy(max_queue_depth=4096),
+        batch_window_ms=batch_window_ms, max_batch=max_batch,
+    ).start()
+    executor = service._executor
+    try:
+        # Cold start outside every timed window: build each tile's
+        # chain through dates[0] (pays the compiles too).
+        t0 = time.perf_counter()
+        warm = run_load(
+            _Target(service=service),
+            [{"tile": n, "date": dates[0].isoformat(),
+              "request_id": f"sweepwarm{i:03d}"}
+             for i, n in enumerate(names)],
+            concurrency=1, timeout_s=600.0,
+        )
+        cold_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        if warm["serve_ok_total"] != len(names):
+            raise RuntimeError(f"sweep warm-up failed: {warm}")
+        # Pixels per launch member: the bucket's padded pixel count —
+        # what one member of a device launch actually solves over.
+        bucket = sessions[names[0]].serve_bucket()
+        n_pad = bucket.n_pad if bucket is not None else None
+        # AOT the batched program sizes a level can form (outside every
+        # timed window, like the daemon's startup warm-up does): a
+        # level whose first coalesced launch paid the K-member compile
+        # would measure XLA, not serving.
+        from kafka_tpu.serve import batch as serve_batch
+
+        cap = min(len(names), max_batch)
+        serve_batch.aot_compile_buckets(
+            sessions, batch_sizes=tuple(range(1, cap + 1)),
+        )
+
+        def run_level(concurrency: int, date, tag: str) -> dict:
+            # Explicit per-level request ids: run_load's default
+            # load%05d ids REPEAT across calls, and a repeated id reads
+            # the previous level's stale response file back.
+            n_requests = max(concurrency, len(names))
+            plan = [{"tile": names[i % len(names)],
+                     "date": date.isoformat(),
+                     "request_id": f"sweep{tag}n{i:04d}"}
+                    for i in range(n_requests)]
+            m = executor.metrics()
+            launches0 = m["launches"].value() or 0
+            members0 = m["launch_members"].value() or 0
+            rows = run_load(_Target(service=service), plan,
+                            concurrency=concurrency, timeout_s=600.0)
+            launches = (m["launches"].value() or 0) - launches0
+            members = (m["launch_members"].value() or 0) - members0
+            wall = rows["serve_wall_s"]
+            return {
+                "concurrency": concurrency,
+                "serve_p50_ms": rows["serve_p50_ms"],
+                "serve_p99_ms": rows["serve_p99_ms"],
+                "serve_queue_wait_p99_ms":
+                    rows["serve_queue_wait_p99_ms"],
+                "serve_batch_mean_size": rows["serve_batch_mean_size"],
+                "serve_batch_coalesced_total":
+                    rows["serve_batch_coalesced_total"],
+                "serve_rps": rows["serve_rps"],
+                "serve_ok_total": rows["serve_ok_total"],
+                "serve_error_total": rows["serve_error_total"],
+                # Device-level view from the executor counters (mean
+                # members per coalesced launch) and the level's solve
+                # throughput in padded pixels per second over requests
+                # that actually paid a solve (warm_noop/cache excluded
+                # — they move no pixels).
+                "serve_device_batch_mean": (
+                    round(members / launches, 3) if launches else None
+                ),
+                "serve_solved_total": rows["serve_solved_total"],
+                "serve_px_s": (
+                    round(rows["serve_solved_total"] * n_pad / wall, 1)
+                    if n_pad and wall and wall > 0 else None
+                ),
+            }
+
+        sweep = [run_level(c, dates[stride * (1 + i)], f"c{c}i{i}")
+                 for i, c in enumerate(levels)]
+        top = sweep[-1]
+        # The unbatched baseline, SAME run, same warm sessions: window
+        # off, a fresh grid window, the top concurrency again.
+        service.set_batch_window(0.0)
+        baseline = run_level(levels[-1], dates[stride * (1 + len(levels))],
+                             "base")
+        service.set_batch_window(batch_window_ms)
+        errors = sum(lv["serve_error_total"] for lv in sweep) \
+            + baseline["serve_error_total"]
+        return {
+            "serve_sweep": sweep,
+            "serve_sweep_concurrencies": levels,
+            "serve_cold_ms": cold_ms,
+            "serve_batched_px_s": top["serve_px_s"],
+            "serve_batch_mean_size": top["serve_batch_mean_size"],
+            "serve_device_batch_mean": top["serve_device_batch_mean"],
+            "serve_queue_wait_p99_ms": top["serve_queue_wait_p99_ms"],
+            "serve_unbatched_p99_ms": baseline["serve_p99_ms"],
+            "serve_unbatched_queue_wait_p99_ms":
+                baseline["serve_queue_wait_p99_ms"],
+            "serve_unbatched_px_s": baseline["serve_px_s"],
+            "serve_error_total": errors,
+        }
+    finally:
+        service.close()
+
+
 def bench_fleet(
     tmpdir: str,
     replicas: int = 3,
@@ -575,6 +778,14 @@ def main(argv=None) -> int:
                          "router, emitting the serve_fleet_* rows")
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--concurrency-sweep", default=None, metavar="LIST",
+                    help="comma-separated concurrency levels (e.g. "
+                         "1,8,32): run the self-contained coalesced-"
+                         "serving sweep — per-level serve_p99_ms / "
+                         "queue_wait / batch-size rows plus the gated "
+                         "serve_batched_px_s throughput and an "
+                         "unbatched same-run baseline (synthetic "
+                         "mode only)")
     ap.add_argument("--backoff", type=int, default=0, metavar="K",
                     help="honor retry_after_s rejection hints with up "
                          "to K backoff waits per request (counted into "
@@ -598,6 +809,24 @@ def main(argv=None) -> int:
                          "embedded as the live_telemetry series "
                          "(--root mode)")
     args = ap.parse_args(argv)
+
+    if args.concurrency_sweep:
+        if args.root:
+            print("--concurrency-sweep is self-contained (synthetic "
+                  "mode); drop --root", file=sys.stderr)
+            return 2
+        import shutil
+        import tempfile
+
+        levels = [int(c) for c in args.concurrency_sweep.split(",")
+                  if c.strip()]
+        tmp = tempfile.mkdtemp(prefix="kafka_loadgen_sweep_")
+        try:
+            rows = bench_concurrency_sweep(tmp, concurrencies=levels)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        print(json.dumps(rows))
+        return 1 if rows.get("serve_error_total") else 0
 
     if args.root:
         from kafka_tpu.serve.synthetic import (
